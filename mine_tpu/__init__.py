@@ -1,0 +1,25 @@
+"""mine_tpu — a TPU-native (JAX/XLA/Flax/Pallas) single-image novel view
+synthesis framework with the capabilities of MINE (ICCV 2021,
+vincentfung13/MINE): an encoder–decoder predicts an N-plane multiplane image
+(per-plane RGB + density sigma) from one RGB image plus N sampled disparities;
+novel views are rendered by warping each plane with a per-plane homography and
+volume-compositing.
+
+Built TPU-first, not as a port:
+  * pure-functional geometry/rendering ops (explicit PRNG keys, static shapes)
+  * Flax NHWC models compiled by XLA onto the MXU
+  * data/plane parallelism via `jax.sharding.Mesh` + jit sharding constraints
+    (GSPMD inserts the collectives; BatchNorm statistics become global — the
+    SPMD equivalent of the reference's SyncBatchNorm, synthesis_task.py:106-111)
+  * Pallas kernels for the HBM-bound homography warp/composite hot path
+
+Layer map (mirrors SURVEY.md section 1; modules land milestone by milestone):
+  cli       train_cli.py, infer (image -> video)
+  trainer   mine_tpu.train     (step fn, loop, checkpointing, eval)
+  models    mine_tpu.models    (ResNet encoder, MPI decoder, embedder)
+  ops       mine_tpu.ops       (rendering, warp, sampling) + mine_tpu.kernels
+  data      mine_tpu.data      (COLMAP reader, LLFF dataset, synthetic scenes)
+  runtime   mine_tpu.parallel  (mesh, shardings) — XLA collectives over ICI/DCN
+"""
+
+__version__ = "0.1.0"

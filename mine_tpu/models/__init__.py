@@ -1,0 +1,3 @@
+from mine_tpu.models.embedder import positional_encoding, embedding_dim  # noqa: F401
+from mine_tpu.models.resnet import ResnetEncoder  # noqa: F401
+from mine_tpu.models.decoder import MPIDecoder  # noqa: F401

@@ -1,0 +1,115 @@
+"""Disparity-conditioned MPI decoder (monodepth2-style U-Net).
+
+Reference: network/monodepth2/depth_decoder.py. Semantics preserved:
+  * each of the S disparities is positionally encoded (21-dim for multires=10)
+    and appended as constant channel maps to every skip feature
+  * features are replicated S times — the effective batch through the decoder
+    is B*S (depth_decoder.py:105-116); this axis is the natural sharding axis
+    for data*plane parallelism on a TPU mesh
+  * a downsample-conv-upsample "receptive-field extension" neck on the last
+    encoder feature (depth_decoder.py:56-61,97-101)
+  * 5 up-stages with skip connections, 4-channel output heads at scales 0-3
+  * rgb = sigmoid, sigma = |x|+1e-4 (or sigmoid in alpha mode), optional
+    whole-plane sigma dropout (depth_decoder.py:138-144)
+
+TPU-first: NHWC compute (bfloat16-able); outputs are returned as float32
+[B, S, 4, H_s, W_s] volumes for the rendering ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mine_tpu.models import embedder
+from mine_tpu.models.layers import (Conv, ConvBlock, ConvBNLeaky,
+                                    max_pool_3x3_s2, upsample_nearest_2x)
+
+NUM_CH_DEC = (16, 32, 64, 128, 256)
+
+
+class MPIDecoder(nn.Module):
+    num_ch_enc: Tuple[int, ...]  # encoder channels, e.g. (64,256,512,1024,2048)
+    pos_encoding_multires: int = 10
+    use_alpha: bool = False
+    scales: Sequence[int] = (0, 1, 2, 3)
+    num_output_channels: int = 4
+    use_skips: bool = True
+    sigma_dropout_rate: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, features, disparity, train: bool):
+        """
+        Args:
+          features: 5 NHWC encoder maps at strides 2/4/8/16/32
+          disparity: [B, S]
+        Returns:
+          dict {scale: [B, S, 4, H_s, W_s] float32}, scale 0 = full res.
+        """
+        B, S = disparity.shape
+        dd = features[-1].dtype if self.dtype is None else self.dtype
+
+        emb = embedder.positional_encoding(
+            disparity.reshape(B * S, 1).astype(jnp.float32),
+            self.pos_encoding_multires).astype(dd)  # [B*S, E]
+
+        def expand_cat(feat):
+            """[B,h,w,C] -> [B*S,h,w,C+E] with the plane embedding appended."""
+            _, h, w, C = feat.shape
+            f = jnp.broadcast_to(feat[:, None], (B, S, h, w, C))
+            f = f.reshape(B * S, h, w, C)
+            e = jnp.broadcast_to(emb[:, None, None, :],
+                                 (B * S, h, w, emb.shape[-1]))
+            return jnp.concatenate([f, e], axis=-1)
+
+        # receptive-field extension neck on the deepest feature
+        x = features[-1].astype(dd)
+        x = ConvBNLeaky(512, 1, dtype=self.dtype, name="conv_down1")(
+            max_pool_3x3_s2(x), train)
+        x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_down2")(
+            max_pool_3x3_s2(x), train)
+        x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_up1")(
+            upsample_nearest_2x(x), train)
+        x = ConvBNLeaky(self.num_ch_enc[-1], 1, dtype=self.dtype, name="conv_up2")(
+            upsample_nearest_2x(x), train)
+        # The down/up round trip overshoots when H/32 is not a multiple of 4
+        # (maxpool ceils, upsample doubles); crop back. No-op at the
+        # reference's training resolutions (H, W multiples of 128).
+        x = x[:, :features[-1].shape[1], :features[-1].shape[2], :]
+
+        x = expand_cat(x)  # replaces features[-1] as the decoder stem
+
+        outputs = {}
+        for i in range(4, -1, -1):
+            x = ConvBlock(NUM_CH_DEC[i], dtype=self.dtype,
+                          name=f"upconv_{i}_0")(x, train)
+            x = upsample_nearest_2x(x)
+            if self.use_skips and i > 0:
+                x = jnp.concatenate(
+                    [x, expand_cat(features[i - 1].astype(dd))], axis=-1)
+            x = ConvBlock(NUM_CH_DEC[i], dtype=self.dtype,
+                          name=f"upconv_{i}_1")(x, train)
+            if i in self.scales:
+                out = Conv(self.num_output_channels, 3, pad_mode="reflect",
+                           dtype=self.dtype, name=f"dispconv_{i}")(x)
+                out = out.astype(jnp.float32)  # rendering happens in fp32
+                rgb = nn.sigmoid(out[..., 0:3])
+                if self.use_alpha:
+                    sigma = nn.sigmoid(out[..., 3:4])
+                else:
+                    sigma = jnp.abs(out[..., 3:4]) + 1e-4
+                if self.sigma_dropout_rate > 0.0 and train:
+                    # whole-plane dropout (reference F.dropout2d on sigma)
+                    sigma = nn.Dropout(
+                        rate=self.sigma_dropout_rate,
+                        broadcast_dims=(1, 2, 3),
+                        deterministic=not train)(sigma)
+                mpi = jnp.concatenate([rgb, sigma], axis=-1)  # [B*S,h,w,4]
+                h, w = mpi.shape[1], mpi.shape[2]
+                # -> [B,S,4,h,w] for the rendering ops
+                outputs[i] = jnp.transpose(
+                    mpi.reshape(B, S, h, w, 4), (0, 1, 4, 2, 3))
+        return outputs

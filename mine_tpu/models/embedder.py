@@ -1,0 +1,29 @@
+"""NeRF positional encoding for scalar disparity conditioning.
+
+Reference: utils.Embedder/get_embedder (utils.py:144-193) with input_dims=1,
+include_input, log-sampled frequencies 2^0..2^(multires-1), sin+cos per
+frequency -> output dim 1 + 2*multires (21 for multires=10).
+
+Output ordering matches the reference's embed_fns concatenation:
+[x, sin(2^0 x), cos(2^0 x), sin(2^1 x), cos(2^1 x), ...].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def embedding_dim(multires: int, input_dims: int = 1) -> int:
+    return input_dims * (1 + 2 * multires)
+
+
+def positional_encoding(x: jnp.ndarray, multires: int = 10) -> jnp.ndarray:
+    """Encode [..., 1] scalars to [..., 1 + 2*multires] features."""
+    freqs = 2.0 ** jnp.arange(multires, dtype=x.dtype)  # [F]
+    ang = x[..., None] * freqs  # [..., 1, F]
+    sin = jnp.sin(ang)
+    cos = jnp.cos(ang)
+    # interleave sin/cos per frequency: [..., 1, F, 2] -> [..., 2F]
+    sc = jnp.stack([sin, cos], axis=-1)
+    sc = sc.reshape(x.shape[:-1] + (x.shape[-1] * 2 * multires,))
+    return jnp.concatenate([x, sc], axis=-1)

@@ -1,0 +1,146 @@
+"""Shared Flax building blocks with torch-compatible semantics.
+
+All convs use NHWC (TPU-native) with *explicit* padding so outputs match
+torch's symmetric padding exactly (flax 'SAME' pads asymmetrically for even
+strides). Initializers reproduce torch defaults so from-scratch training is
+distributionally comparable and converted checkpoints drop in unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.nn import initializers
+
+Dtype = jnp.dtype
+
+# torch Conv2d default: kaiming_uniform(a=sqrt(5)) == U(+-sqrt(1/fan_in))
+torch_conv_kernel_init = initializers.variance_scaling(
+    1.0 / 3.0, "fan_in", "uniform")
+# torchvision ResNet conv init: kaiming_normal(mode='fan_out')
+resnet_kernel_init = initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def torch_bias_init(key, shape, dtype, fan_in: int):
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class Conv(nn.Module):
+    """NHWC conv with torch-style symmetric padding and init."""
+    features: int
+    kernel_size: int = 3
+    strides: int = 1
+    padding: Optional[int] = None  # default: (k-1)//2 like torch common usage
+    use_bias: bool = True
+    pad_mode: str = "zeros"  # "zeros" | "reflect"
+    kernel_init: Callable = torch_conv_kernel_init
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.kernel_size
+        p = (k - 1) // 2 if self.padding is None else self.padding
+        if p > 0 and self.pad_mode == "reflect":
+            x = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+            pad = ((0, 0), (0, 0))
+        else:
+            pad = ((p, p), (p, p))
+        fan_in = k * k * x.shape[-1]
+        conv = nn.Conv(
+            features=self.features,
+            kernel_size=(k, k),
+            strides=(self.strides, self.strides),
+            padding=pad,
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init,
+            bias_init=lambda key, shape, dtype=jnp.float32: torch_bias_init(
+                key, shape, dtype, fan_in),
+            dtype=self.dtype,
+            name="conv",
+        )
+        return conv(x)
+
+
+class BatchNorm(nn.Module):
+    """torch-compatible BatchNorm2d (momentum 0.1, eps 1e-5), float32 stats.
+
+    Without an axis_name this is still *synchronized* across data-parallel
+    shards under GSPMD/jit: the batch axis is a plain array axis of the global
+    computation, so the mean/var are global means and XLA inserts the
+    cross-replica collectives — the SPMD equivalent of the reference's
+    SyncBatchNorm (synthesis_task.py:106-111).
+    """
+    use_running_average: bool
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        out_dtype = x.dtype if self.dtype is None else self.dtype
+        norm = nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=1.0 - self.momentum,  # flax: ra = m*ra + (1-m)*batch
+            epsilon=self.epsilon,
+            dtype=jnp.float32,
+            name="bn",
+        )
+        return norm(x.astype(jnp.float32)).astype(out_dtype)
+
+
+def max_pool_3x3_s2(x):
+    """torch MaxPool2d(3, stride=2, padding=1) — pads with -inf, not zeros."""
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+
+def upsample_nearest_2x(x):
+    """torch UpsamplingNearest2d(scale_factor=2) on NHWC."""
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+
+
+def downsample_nearest(x, factor: int):
+    """torch nn.Upsample(size=H/2**s) nearest for exact integer factors is a
+    strided slice (index floor(i*factor)). Reference: synthesis_task.py:129-133.
+    """
+    if factor == 1:
+        return x
+    return x[:, ::factor, ::factor, :]
+
+
+class ConvBlock(nn.Module):
+    """Reflect-pad 3x3 conv (with bias) + BN + ELU.
+
+    Reference: monodepth2/layers.py:106-120 (ConvBlock = Conv3x3 + BN + ELU,
+    Conv3x3 uses ReflectionPad2d).
+    """
+    features: int
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = Conv(self.features, 3, pad_mode="reflect", dtype=self.dtype,
+                 name="conv3x3")(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        return nn.elu(x)
+
+
+class ConvBNLeaky(nn.Module):
+    """kxk conv (no bias, zero pad) + BN + LeakyReLU(0.1).
+
+    Reference: depth_decoder.conv (depth_decoder.py:17-32, batchnorm branch).
+    """
+    features: int
+    kernel_size: int
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = Conv(self.features, self.kernel_size, use_bias=False,
+                 dtype=self.dtype, name="conv")(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        return nn.leaky_relu(x, negative_slope=0.1)

@@ -1,0 +1,43 @@
+"""Full MPI predictor: encoder + disparity-conditioned decoder.
+
+Replaces SynthesisTask.mpi_predictor (synthesis_task.py:222-228) as a single
+Flax module so the whole forward lives in one XLA graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mine_tpu.models.decoder import MPIDecoder
+from mine_tpu.models.resnet import ResnetEncoder, num_ch_enc
+
+
+class MPIPredictor(nn.Module):
+    num_layers: int = 50
+    pos_encoding_multires: int = 10
+    use_alpha: bool = False
+    scales: Sequence[int] = (0, 1, 2, 3)
+    sigma_dropout_rate: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    def setup(self):
+        self.backbone = ResnetEncoder(num_layers=self.num_layers,
+                                      dtype=self.dtype, name="backbone")
+        self.decoder = MPIDecoder(
+            num_ch_enc=num_ch_enc(self.num_layers),
+            pos_encoding_multires=self.pos_encoding_multires,
+            use_alpha=self.use_alpha,
+            scales=tuple(self.scales),
+            sigma_dropout_rate=self.sigma_dropout_rate,
+            dtype=self.dtype,
+            name="decoder")
+
+    def __call__(self, src_imgs, disparity, train: bool):
+        """src_imgs [B,H,W,3] in [0,1]; disparity [B,S] ->
+        list of 4 volumes [B,S,4,H/2^s,W/2^s] (scale order 0,1,2,3)."""
+        feats = self.backbone(src_imgs, train)
+        outputs = self.decoder(list(feats), disparity, train)
+        return [outputs[s] for s in sorted(outputs)]

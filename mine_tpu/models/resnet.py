@@ -1,0 +1,142 @@
+"""Flax ResNet encoder matching torchvision layouts (18/34/50/101/152).
+
+Reference: network/monodepth2/resnet_encoder.py — ImageNet-normalizes the
+input and returns 5 feature maps (conv1+relu, then the 4 residual stages) at
+strides 2/4/8/16/32 with channels num_ch_enc = [64,64,128,256,512] (*4 on the
+last four for Bottleneck variants, resnet_encoder.py:86).
+
+TPU-first: NHWC, explicit symmetric padding (so converted torchvision weights
+reproduce torch outputs bit-for-bit up to conv reassociation), bfloat16-able
+compute with float32 BatchNorm. Converted checkpoints load via the weight
+conversion tool (tools/, ships with the checkpointing milestone).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mine_tpu.models import layers
+from mine_tpu.models.layers import BatchNorm, Conv, resnet_kernel_init
+
+# ImageNet normalization (resnet_encoder.py:88-91)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+           101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+_BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
+
+
+def num_ch_enc(num_layers: int) -> Tuple[int, ...]:
+    base = [64, 64, 128, 256, 512]
+    if num_layers > 34:
+        base[1:] = [c * 4 for c in base[1:]]
+    return tuple(base)
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    strides: int = 1
+    downsample: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = Conv(self.planes, 3, strides=self.strides, use_bias=False,
+                 kernel_init=resnet_kernel_init, dtype=self.dtype, name="conv1")(x)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = Conv(self.planes, 3, use_bias=False, kernel_init=resnet_kernel_init,
+                 dtype=self.dtype, name="conv2")(y)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
+        if self.downsample:
+            residual = Conv(self.planes, 1, strides=self.strides, use_bias=False,
+                            kernel_init=resnet_kernel_init, dtype=self.dtype,
+                            name="downsample_conv")(x)
+            residual = BatchNorm(use_running_average=not train, dtype=self.dtype,
+                                 name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """torchvision-style bottleneck (stride on the 3x3 conv, 'ResNet v1.5')."""
+    planes: int
+    strides: int = 1
+    downsample: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        residual = x
+        y = Conv(self.planes, 1, use_bias=False, kernel_init=resnet_kernel_init,
+                 dtype=self.dtype, name="conv1")(x)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = Conv(self.planes, 3, strides=self.strides, use_bias=False,
+                 kernel_init=resnet_kernel_init, dtype=self.dtype, name="conv2")(y)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn2")(y)
+        y = nn.relu(y)
+        y = Conv(self.planes * 4, 1, use_bias=False, kernel_init=resnet_kernel_init,
+                 dtype=self.dtype, name="conv3")(y)
+        y = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn3")(y)
+        if self.downsample:
+            residual = Conv(self.planes * 4, 1, strides=self.strides,
+                            use_bias=False, kernel_init=resnet_kernel_init,
+                            dtype=self.dtype, name="downsample_conv")(x)
+            residual = BatchNorm(use_running_average=not train, dtype=self.dtype,
+                                 name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResnetEncoder(nn.Module):
+    """5-feature-map ResNet backbone.
+
+    __call__(img [B,H,W,3] in [0,1], train) ->
+        (conv1_out [B,H/2,W/2,64], block1..block4 at /4../32).
+    """
+    num_layers: int = 50
+    dtype: Optional[jnp.dtype] = None
+
+    @property
+    def num_ch_enc(self) -> Tuple[int, ...]:
+        return num_ch_enc(self.num_layers)
+
+    @nn.compact
+    def __call__(self, img, train: bool):
+        if self.num_layers not in _BLOCKS:
+            raise ValueError(f"{self.num_layers} is not a valid resnet depth")
+        blocks = _BLOCKS[self.num_layers]
+        block_cls = Bottleneck if _BOTTLENECK[self.num_layers] else BasicBlock
+        expansion = 4 if _BOTTLENECK[self.num_layers] else 1
+
+        mean = jnp.asarray(IMAGENET_MEAN, img.dtype)
+        std = jnp.asarray(IMAGENET_STD, img.dtype)
+        x = (img - mean) / std
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+
+        x = Conv(64, 7, strides=2, padding=3, use_bias=False,
+                 kernel_init=resnet_kernel_init, dtype=self.dtype, name="conv1")(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype, name="bn1")(x)
+        conv1_out = nn.relu(x)
+
+        x = layers.max_pool_3x3_s2(conv1_out)
+        feats = []
+        inplanes = 64
+        for stage, (n_blocks, planes) in enumerate(
+                zip(blocks, (64, 128, 256, 512))):
+            strides = 1 if stage == 0 else 2
+            for b in range(n_blocks):
+                s = strides if b == 0 else 1
+                need_down = (b == 0) and (s != 1 or inplanes != planes * expansion)
+                x = block_cls(planes, strides=s, downsample=need_down,
+                              dtype=self.dtype,
+                              name=f"layer{stage + 1}_{b}")(x, train)
+                inplanes = planes * expansion
+            feats.append(x)
+
+        return (conv1_out, *feats)

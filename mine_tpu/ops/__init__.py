@@ -1,0 +1,1 @@
+from mine_tpu.ops import rendering, sampling, warp  # noqa: F401

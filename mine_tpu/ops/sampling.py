@@ -1,0 +1,123 @@
+"""Disparity sampling + sparse-point gathers, with explicit PRNG keys.
+
+Replaces operations/rendering_utils.py of the reference. The reference draws
+from the unseeded global torch RNG (rendering_utils.py:65,86,115); we thread
+`jax.random` keys, making training reproducible by construction without
+changing the sampling distributions.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def uniformly_sample_disparity_from_linspace_bins(key: jax.Array,
+                                                  batch_size: int,
+                                                  num_bins: int,
+                                                  start: float,
+                                                  end: float) -> jnp.ndarray:
+    """Stratified disparity samples: one uniform draw inside each of S equal
+    bins spanning [start, end], start > end (disparity large -> small, i.e.
+    depth near -> far). Reference: rendering_utils.py:70-88.
+
+    Returns: [B, S], strictly descending in expectation (bin order).
+    """
+    assert start > end
+    bin_edges = jnp.linspace(start, end, num_bins + 1, dtype=jnp.float32)
+    interval = bin_edges[1] - bin_edges[0]  # negative scalar
+    u = jax.random.uniform(key, (batch_size, num_bins), dtype=jnp.float32)
+    return bin_edges[None, :-1] + interval * u
+
+
+def uniformly_sample_disparity_from_bins(key: jax.Array,
+                                         batch_size: int,
+                                         disparity_np) -> jnp.ndarray:
+    """Stratified samples from explicit (possibly non-uniform) bin edges,
+    descending. Reference: rendering_utils.py:47-67.
+
+    Args: disparity_np: [S+1] descending bin edges.
+    Returns: [B, S]
+    """
+    bin_edges = jnp.asarray(disparity_np, dtype=jnp.float32)
+    starts = bin_edges[:-1]
+    intervals = bin_edges[1:] - bin_edges[:-1]
+    S = starts.shape[0]
+    u = jax.random.uniform(key, (batch_size, S), dtype=jnp.float32)
+    return starts[None, :] + intervals[None, :] * u
+
+
+def fixed_disparity_linspace(batch_size: int, num_bins: int,
+                             start: float, end: float) -> jnp.ndarray:
+    """Deterministic plane disparities (mpi.fix_disparity / inference).
+
+    Reference: synthesis_task.py:41-44.
+    """
+    d = jnp.linspace(start, end, num_bins, dtype=jnp.float32)
+    return jnp.broadcast_to(d[None, :], (batch_size, num_bins))
+
+
+def sample_pdf(key: jax.Array,
+               values: jnp.ndarray,
+               weights: jnp.ndarray,
+               n_samples: int) -> jnp.ndarray:
+    """NeRF-style inverse-CDF importance sampling.
+
+    Draw `n_samples` from the distribution approximated by point masses
+    `weights` at `values` (converted to bin edges at midpoints). Degenerate
+    zero-width CDF intervals (from edge clamping) fall back to the bin middle.
+    Reference: rendering_utils.sample_pdf (rendering_utils.py:91-140).
+
+    Args:
+      values: [B, 1, N, S]
+      weights: [B, 1, N, S]
+    Returns: samples [B, 1, N, n_samples]
+    """
+    B, _, N, S = weights.shape
+
+    mid = (values[..., 1:] + values[..., :-1]) * 0.5
+    bin_edges = jnp.concatenate([values[..., :1], mid, values[..., -1:]], axis=-1)  # [B,1,N,S+1]
+
+    pdf = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-5)
+    cdf = jnp.cumsum(pdf, axis=-1)
+    cdf = jnp.concatenate([jnp.zeros_like(cdf[..., :1]), cdf], axis=-1)  # [B,1,N,S+1]
+
+    u = jax.random.uniform(key, (B, 1, N, n_samples), dtype=weights.dtype)
+
+    # searchsorted over the last axis, batched
+    cdf_flat = cdf.reshape(B * N, S + 1)
+    u_flat = u.reshape(B * N, n_samples)
+    idx = jax.vmap(lambda c, uu: jnp.searchsorted(c, uu, side="right"))(cdf_flat, u_flat)
+    idx = idx.reshape(B, 1, N, n_samples)
+    lower = jnp.clip(idx - 1, 0, S)
+    upper = jnp.clip(idx, None, S)
+
+    cdf_lo = jnp.take_along_axis(cdf, lower, axis=-1)
+    cdf_hi = jnp.take_along_axis(cdf, upper, axis=-1)
+    bin_lo = jnp.take_along_axis(bin_edges, lower, axis=-1)
+    bin_hi = jnp.take_along_axis(bin_edges, upper, axis=-1)
+
+    cdf_interval = cdf_hi - cdf_lo
+    t = (u - cdf_lo) / jnp.clip(cdf_interval, 1e-5, None)
+    t = jnp.where(cdf_interval <= 1e-4, 0.5, t)
+    return bin_lo + t * (bin_hi - bin_lo)
+
+
+def gather_pixel_by_pxpy(img: jnp.ndarray, pxpy: jnp.ndarray) -> jnp.ndarray:
+    """Read image values at (rounded, clamped) sparse pixel locations.
+
+    Gradients flow through the gathered values, not the indices — same as the
+    reference, which computes indices under no_grad
+    (rendering_utils.py:27-44).
+
+    Args:
+      img: [B, C, H, W]
+      pxpy: [B, 2, N] float pixel coords (x, y)
+    Returns: [B, C, N]
+    """
+    B, C, H, W = img.shape
+    px = jnp.clip(jnp.round(pxpy[:, 0, :]).astype(jnp.int32), 0, W - 1)  # [B,N]
+    py = jnp.clip(jnp.round(pxpy[:, 1, :]).astype(jnp.int32), 0, H - 1)
+    flat_idx = py * W + px  # [B, N]
+    img_flat = img.reshape(B, C, H * W)
+    return jnp.take_along_axis(img_flat, flat_idx[:, None, :], axis=2)

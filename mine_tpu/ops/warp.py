@@ -1,0 +1,113 @@
+"""Homography warping of the MPI plane volume.
+
+Replaces the reference's HomographySample (homography_sampler.py:10-141),
+whose hot op is `F.grid_sample(padding_mode='border', align_corners=False)`
+over a B*S x 7 x H x W volume. On TPU this is a gather; the XLA path below is
+the reference implementation, designed so a Pallas kernel with the same
+contract can slot in as the fused fast path.
+
+Sampling semantics (must match for checkpoint parity — SURVEY.md section 7
+"hard parts" #1): the reference normalizes pixel coords p to grid
+g = (p+0.5)/(0.5*size) - 1 (homography_sampler.py:136-137) and then
+grid_sample with align_corners=False maps g back to pixels as
+(g+1)*size/2 - 0.5 == p. Net effect: bilinear sampling at continuous pixel
+coordinates with border clamping. We implement that directly, skipping the
+[-1,1] round trip.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mine_tpu import geometry
+
+
+def bilinear_sample(src: jnp.ndarray,
+                    coords_x: jnp.ndarray,
+                    coords_y: jnp.ndarray) -> jnp.ndarray:
+    """Bilinear sample with border padding at continuous pixel coords.
+
+    Equivalent to torch grid_sample(border, align_corners=False) after the
+    reference's grid normalization (see module docstring).
+
+    Args:
+      src: [B, C, H, W]
+      coords_x, coords_y: [B, Ho, Wo] sample locations in src pixel coords
+    Returns: [B, C, Ho, Wo]
+    """
+    B, C, H, W = src.shape
+    # Border padding == clamp the sampling location into the pixel-center box.
+    x = jnp.clip(coords_x, 0.0, W - 1.0)
+    y = jnp.clip(coords_y, 0.0, H - 1.0)
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    tx = x - x0
+    ty = y - y0
+
+    x0i = x0.astype(jnp.int32)
+    y0i = y0.astype(jnp.int32)
+    x1i = jnp.minimum(x0i + 1, W - 1)
+    y1i = jnp.minimum(y0i + 1, H - 1)
+
+    def gather_one(img_chw, yi, xi):
+        # img_chw [C,H,W]; yi/xi [Ho,Wo] -> [C,Ho,Wo]
+        return img_chw[:, yi, xi]
+
+    g = jax.vmap(gather_one)
+    v00 = g(src, y0i, x0i)
+    v01 = g(src, y0i, x1i)
+    v10 = g(src, y1i, x0i)
+    v11 = g(src, y1i, x1i)
+
+    tx = tx[:, None, :, :]
+    ty = ty[:, None, :, :]
+    top = v00 * (1.0 - tx) + v01 * tx
+    bot = v10 * (1.0 - tx) + v11 * tx
+    return top * (1.0 - ty) + bot * ty
+
+
+def homography_warp(src_BCHW: jnp.ndarray,
+                    d_src: jnp.ndarray,
+                    G_tgt_src: jnp.ndarray,
+                    K_src_inv: jnp.ndarray,
+                    K_tgt: jnp.ndarray,
+                    meshgrid_tgt: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Warp source-plane images into the target camera via inverse homography.
+
+    For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
+    invert it (closed form, no grad — matching the reference's no_grad inverse,
+    homography_sampler.py:112-113), map the target pixel grid into source
+    pixels, bilinear-sample with border padding, and report which target pixels
+    landed inside the source image.
+
+    Reference: HomographySample.sample (homography_sampler.py:58-141).
+
+    Args:
+      src_BCHW: [B', C, H, W] plane images (B' is typically B*S)
+      d_src: [B'] plane depths
+      G_tgt_src: [B', 4, 4]
+      K_src_inv, K_tgt: [B', 3, 3]
+      meshgrid_tgt: [3, Ht, Wt] homogeneous target pixel grid
+    Returns:
+      tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
+    """
+    Bp, C, H, W = src_BCHW.shape
+    _, Ht, Wt = meshgrid_tgt.shape
+
+    H_tgt_src = geometry.homography_tgt_src(K_tgt, K_src_inv, G_tgt_src, d_src)
+    H_src_tgt = jax.lax.stop_gradient(geometry.inverse_3x3(H_tgt_src))
+
+    grid = meshgrid_tgt.reshape(3, Ht * Wt)
+    src_homo = jnp.einsum("bij,jn->bin", H_src_tgt, grid)  # [B',3,HtWt]
+    src_xy = src_homo[:, 0:2, :] / src_homo[:, 2:3, :]
+    x = src_xy[:, 0, :].reshape(Bp, Ht, Wt)
+    y = src_xy[:, 1, :].reshape(Bp, Ht, Wt)
+
+    valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
+
+    tgt = bilinear_sample(src_BCHW, x, y)
+    return tgt, valid

@@ -1,0 +1,27 @@
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+This is JAX's standard fake-multi-device mechanism (SURVEY.md section 4) —
+multi-chip sharding logic is validated here without TPU hardware.
+
+In this container an `axon` TPU PJRT plugin is registered by a sitecustomize
+hook at interpreter startup, which force-sets jax_platforms="axon,cpu" via
+jax.config (overriding any JAX_PLATFORMS=cpu env var); two concurrent test
+runs would then deadlock on the single tunneled TPU chip. No backend is
+*initialized* until first use, so setting the config back to "cpu" here —
+before any jax computation — keeps the whole suite on CPU. Set
+MINE_TPU_TESTS_ON_TPU=1 to run on real hardware instead.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if os.environ.get("MINE_TPU_TESTS_ON_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_default_matmul_precision", "highest")

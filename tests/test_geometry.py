@@ -1,0 +1,138 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mine_tpu import geometry
+
+
+def random_K(rng, b):
+    K = np.zeros((b, 3, 3), dtype=np.float32)
+    K[:, 0, 0] = rng.uniform(100, 500, b)
+    K[:, 1, 1] = rng.uniform(100, 500, b)
+    K[:, 0, 2] = rng.uniform(50, 300, b)
+    K[:, 1, 2] = rng.uniform(50, 300, b)
+    K[:, 2, 2] = 1.0
+    return K
+
+
+def random_rigid(rng, b):
+    from scipy.spatial.transform import Rotation
+    G = np.tile(np.eye(4, dtype=np.float32), (b, 1, 1))
+    G[:, :3, :3] = Rotation.random(b, random_state=rng).as_matrix().astype(np.float32)
+    G[:, :3, 3] = rng.normal(size=(b, 3)).astype(np.float32)
+    return G
+
+
+def test_pixel_grid():
+    g = np.asarray(geometry.pixel_grid_homogeneous(4, 6))
+    assert g.shape == (3, 4, 6)
+    assert g[0, 0, 3] == 3.0  # x
+    assert g[1, 2, 0] == 2.0  # y
+    assert np.all(g[2] == 1.0)
+
+
+def test_inverse_3x3_matches_numpy():
+    rng = np.random.RandomState(0)
+    A = rng.normal(size=(8, 3, 3)).astype(np.float32) + np.eye(3) * 2
+    inv = np.asarray(geometry.inverse_3x3(jnp.asarray(A)))
+    np.testing.assert_allclose(inv, np.linalg.inv(A), rtol=1e-4, atol=1e-5)
+
+
+def test_inverse_intrinsics_exact():
+    rng = np.random.RandomState(1)
+    K = random_K(rng, 5)
+    K_inv = np.asarray(geometry.inverse_intrinsics(jnp.asarray(K)))
+    np.testing.assert_allclose(K_inv, np.linalg.inv(K), rtol=1e-5, atol=1e-6)
+
+
+def test_rigid_inverse_matches_numpy():
+    rng = np.random.RandomState(2)
+    G = random_rigid(rng, 6)
+    G_inv = np.asarray(geometry.rigid_inverse(jnp.asarray(G)))
+    np.testing.assert_allclose(G_inv, np.linalg.inv(G), rtol=1e-4, atol=1e-5)
+
+
+def test_scale_intrinsics():
+    rng = np.random.RandomState(3)
+    K = random_K(rng, 2)
+    K1 = np.asarray(geometry.scale_intrinsics(jnp.asarray(K), 1))
+    np.testing.assert_allclose(K1[:, 0, 0], K[:, 0, 0] / 2)
+    np.testing.assert_allclose(K1[:, 2, 2], 1.0)
+
+
+def test_transform_points_matches_homogeneous():
+    rng = np.random.RandomState(4)
+    G = random_rigid(rng, 3)
+    xyz = rng.normal(size=(3, 3, 17)).astype(np.float32)
+    got = np.asarray(geometry.transform_points(jnp.asarray(G), jnp.asarray(xyz)))
+    xyz_h = np.concatenate([xyz, np.ones((3, 1, 17), np.float32)], axis=1)
+    want = np.einsum("bij,bjn->bin", G, xyz_h)[:, :3]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_homography_identity_pose_is_scaled_identity():
+    """With G = I the plane homography must be the identity map K K^-1 = I."""
+    rng = np.random.RandomState(5)
+    K = jnp.asarray(random_K(rng, 4))
+    G = jnp.tile(jnp.eye(4), (4, 1, 1))
+    d = jnp.full((4,), 2.5)
+    H = geometry.homography_tgt_src(K, geometry.inverse_intrinsics(K), G, d)
+    np.testing.assert_allclose(np.asarray(H), np.tile(np.eye(3), (4, 1, 1)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_homography_translation_shifts_pixels():
+    """Camera translating by tx along x: pixels shift by -fx*tx/d.
+
+    A point on the plane at depth d with src pixel (px,py) has tgt camera
+    coords (X - tx, Y, d) -> tgt pixel px - fx*tx/d.
+    """
+    fx = 100.0
+    d = 4.0
+    tx = 0.8
+    K = jnp.asarray([[[fx, 0, 50.0], [0, fx, 40.0], [0, 0, 1.0]]])
+    # moving the camera +tx means G_tgt_src has translation -tx
+    G = jnp.eye(4)[None].at[0, 0, 3].set(-tx)
+    H = geometry.homography_tgt_src(K, geometry.inverse_intrinsics(K), G,
+                                    jnp.asarray([d]))
+    p_src = jnp.asarray([60.0, 40.0, 1.0])
+    p_tgt = np.asarray(H[0] @ p_src)
+    p_tgt = p_tgt / p_tgt[2]
+    np.testing.assert_allclose(p_tgt[0], 60.0 - fx * tx / d, rtol=1e-5)
+    np.testing.assert_allclose(p_tgt[1], 40.0, rtol=1e-5)
+
+
+def test_plane_xyz_src_geometry():
+    """Plane points must lie at depth 1/disparity and reproject to the grid."""
+    rng = np.random.RandomState(6)
+    K = random_K(rng, 2)
+    disp = np.array([[1.0, 0.5, 0.25], [0.8, 0.4, 0.2]], dtype=np.float32)
+    grid = geometry.pixel_grid_homogeneous(5, 7)
+    xyz = np.asarray(geometry.plane_xyz_src(
+        grid, jnp.asarray(disp), geometry.inverse_intrinsics(jnp.asarray(K))))
+    assert xyz.shape == (2, 3, 3, 5, 7)
+    # z == depth everywhere
+    for b in range(2):
+        for s in range(3):
+            np.testing.assert_allclose(xyz[b, s, 2], 1.0 / disp[b, s], rtol=1e-5)
+    # reprojection: K @ xyz == pixel * depth
+    proj = np.einsum("bij,bsjn->bsin", K, xyz.reshape(2, 3, 3, 35))
+    proj = proj / proj[:, :, 2:3]
+    np.testing.assert_allclose(proj[0, 0, 0].reshape(5, 7),
+                               np.asarray(grid)[0], rtol=1e-4, atol=1e-4)
+
+
+def test_plane_xyz_tgt_matches_transform():
+    rng = np.random.RandomState(7)
+    G = random_rigid(rng, 2)
+    xyz = rng.normal(size=(2, 3, 3, 4, 6)).astype(np.float32)
+    got = np.asarray(geometry.plane_xyz_tgt(jnp.asarray(xyz), jnp.asarray(G)))
+    want = np.einsum("bij,bsjn->bsin", G[:, :3, :3],
+                     xyz.reshape(2, 3, 3, 24)) + G[:, None, :3, 3, None]
+    np.testing.assert_allclose(got.reshape(2, 3, 3, 24), want, rtol=1e-4, atol=1e-4)
+
+
+def test_intrinsics_from_fov():
+    K = geometry.intrinsics_from_fov(256, 384, 90.0)
+    np.testing.assert_allclose(K[0, 0], 384 * 0.5 / np.tan(np.pi / 4), rtol=1e-6)
+    assert K[0, 2] == 192.0 and K[1, 2] == 128.0

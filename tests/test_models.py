@@ -1,0 +1,141 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mine_tpu.models import embedder
+from mine_tpu.models.decoder import MPIDecoder
+from mine_tpu.models.mpi import MPIPredictor
+from mine_tpu.models.resnet import ResnetEncoder, num_ch_enc
+
+
+def test_positional_encoding_matches_reference_formula():
+    """Reference Embedder (utils.py:144-193): [x, sin(2^0 x), cos(2^0 x), ...]"""
+    x = jnp.asarray([[0.3], [1.7]])
+    out = np.asarray(embedder.positional_encoding(x, multires=10))
+    assert out.shape == (2, 21)
+    np.testing.assert_allclose(out[:, 0], [0.3, 1.7], rtol=1e-6)
+    for i, f in enumerate(2.0 ** np.arange(10)):
+        np.testing.assert_allclose(out[:, 1 + 2 * i], np.sin([0.3 * f, 1.7 * f]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out[:, 2 + 2 * i], np.cos([0.3 * f, 1.7 * f]),
+                                   rtol=1e-4, atol=1e-5)
+    assert embedder.embedding_dim(10) == 21
+
+
+def test_resnet50_feature_shapes_and_channels():
+    B, H, W = 1, 64, 96
+    model = ResnetEncoder(num_layers=50)
+    img = jnp.zeros((B, H, W, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, train=False)
+    feats = model.apply(variables, img, train=False)
+    chans = num_ch_enc(50)
+    assert chans == (64, 256, 512, 1024, 2048)
+    for i, f in enumerate(feats):
+        stride = 2 ** (i + 1)
+        assert f.shape == (B, H // stride, W // stride, chans[i]), (i, f.shape)
+
+
+def test_resnet18_feature_shapes():
+    model = ResnetEncoder(num_layers=18)
+    img = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), img, train=False)
+    feats = model.apply(variables, img, train=False)
+    assert [f.shape[-1] for f in feats] == [64, 64, 128, 256, 512]
+
+
+def test_resnet_matches_torch_conv_padding():
+    """conv1 (7x7 s2 p3) + maxpool output sizes must match torch exactly for
+    the reference's training resolutions."""
+    for H, W in [(384, 512), (256, 384), (128, 384)]:
+        model = ResnetEncoder(num_layers=18)
+        img = jnp.zeros((1, H, W, 3))
+        variables = model.init(jax.random.PRNGKey(0), img, train=False)
+        feats = model.apply(variables, img, train=False)
+        # torch: conv1 -> (H+6-7)//2+1 = H//2; maxpool -> H//4
+        assert feats[0].shape[1:3] == (H // 2, W // 2)
+        assert feats[1].shape[1:3] == (H // 4, W // 4)
+
+
+def test_decoder_output_shapes_and_ranges():
+    B, S, H, W = 1, 4, 64, 96
+    chans = num_ch_enc(18)
+    feats = [jnp.ones((B, H // 2 ** (i + 1), W // 2 ** (i + 1), c))
+             for i, c in enumerate(chans)]
+    disparity = jnp.broadcast_to(jnp.linspace(1.0, 0.1, S)[None], (B, S))
+    model = MPIDecoder(num_ch_enc=chans)
+    variables = model.init(jax.random.PRNGKey(0), feats, disparity, train=False)
+    outs = model.apply(variables, feats, disparity, train=False)
+    assert sorted(outs.keys()) == [0, 1, 2, 3]
+    for s, mpi in outs.items():
+        assert mpi.shape == (B, S, 4, H // 2 ** s, W // 2 ** s)
+        rgb = np.asarray(mpi[:, :, 0:3])
+        sigma = np.asarray(mpi[:, :, 3:])
+        assert rgb.min() >= 0.0 and rgb.max() <= 1.0
+        assert sigma.min() >= 1e-4  # |x| + 1e-4
+
+
+def test_decoder_sigma_alpha_mode():
+    B, S, H, W = 1, 2, 32, 32
+    chans = num_ch_enc(18)
+    feats = [jnp.ones((B, H // 2 ** (i + 1), W // 2 ** (i + 1), c))
+             for i, c in enumerate(chans)]
+    disparity = jnp.ones((B, S)) * 0.5
+    model = MPIDecoder(num_ch_enc=chans, use_alpha=True)
+    variables = model.init(jax.random.PRNGKey(0), feats, disparity, train=False)
+    outs = model.apply(variables, feats, disparity, train=False)
+    sigma = np.asarray(outs[0][:, :, 3:])
+    assert sigma.min() >= 0.0 and sigma.max() <= 1.0
+
+
+def test_decoder_is_disparity_sensitive():
+    """Different plane disparities must produce different planes — the core
+    'continuous depth' conditioning (depth_decoder.py:92-116)."""
+    B, S, H, W = 1, 2, 32, 32
+    chans = num_ch_enc(18)
+    rng = np.random.RandomState(0)
+    feats = [jnp.asarray(rng.normal(size=(B, H // 2 ** (i + 1), W // 2 ** (i + 1),
+                                          c)).astype(np.float32))
+             for i, c in enumerate(chans)]
+    model = MPIDecoder(num_ch_enc=chans)
+    d1 = jnp.asarray([[1.0, 0.9]])
+    variables = model.init(jax.random.PRNGKey(0), feats, d1, train=False)
+    out1 = model.apply(variables, feats, d1, train=False)[0]
+    out2 = model.apply(variables, feats, jnp.asarray([[0.2, 0.1]]), train=False)[0]
+    assert np.abs(np.asarray(out1) - np.asarray(out2)).max() > 1e-4
+
+
+def test_mpi_predictor_end_to_end_shapes():
+    B, S, H, W = 1, 3, 64, 64
+    model = MPIPredictor(num_layers=18)
+    img = jnp.ones((B, H, W, 3)) * 0.5
+    disparity = jnp.broadcast_to(jnp.linspace(1.0, 0.1, S)[None], (B, S))
+    variables = model.init(jax.random.PRNGKey(0), img, disparity, train=False)
+    outs = model.apply(variables, img, disparity, train=False)
+    assert len(outs) == 4
+    for s, mpi in enumerate(outs):
+        assert mpi.shape == (B, S, 4, H // 2 ** s, W // 2 ** s)
+
+
+def test_batchnorm_train_updates_stats():
+    model = MPIPredictor(num_layers=18)
+    img = jnp.ones((2, 32, 32, 3)) * 0.3
+    disparity = jnp.ones((2, 2)) * 0.5
+    variables = model.init(jax.random.PRNGKey(0), img, disparity, train=False)
+    _, mutated = model.apply(variables, img, disparity, train=True,
+                             mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+             for a, b in zip(after, before)]
+    assert max(diffs) > 0.0
+
+
+def test_bfloat16_forward_finite():
+    model = MPIPredictor(num_layers=18, dtype=jnp.bfloat16)
+    img = jnp.ones((1, 32, 32, 3)) * 0.5
+    disparity = jnp.ones((1, 2)) * 0.5
+    variables = model.init(jax.random.PRNGKey(0), img, disparity, train=False)
+    outs = model.apply(variables, img, disparity, train=False)
+    assert outs[0].dtype == jnp.float32  # rendering path gets fp32
+    assert np.all(np.isfinite(np.asarray(outs[0])))

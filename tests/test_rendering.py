@@ -1,0 +1,167 @@
+"""Analytic golden tests for MPI compositing — the invariants the reference's
+stale visual tests encode (operations/test_rendering.py) turned into asserts,
+plus a cross-check of the composite math against a direct torch port."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from mine_tpu import geometry
+from mine_tpu.ops import rendering
+
+
+def make_xyz(B, S, H, W, depths):
+    """Fronto-parallel plane xyz with given depths (pinhole at center)."""
+    disp = 1.0 / np.asarray(depths, dtype=np.float32)
+    disp = np.tile(disp[None], (B, 1))
+    K = jnp.asarray([[[20.0, 0, W / 2], [0, 20.0, H / 2], [0, 0, 1]]] * B)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+    return geometry.plane_xyz_src(grid, jnp.asarray(disp), geometry.inverse_intrinsics(K))
+
+
+def test_alpha_composition_opaque_front():
+    B, K_, H, W = 1, 3, 4, 4
+    alpha = jnp.zeros((B, K_, 1, H, W)).at[:, 0].set(1.0)
+    vals = jnp.stack([jnp.full((B, 3, H, W), v) for v in (0.2, 0.5, 0.9)], axis=1)
+    out, weights = rendering.alpha_composition(alpha, vals)
+    np.testing.assert_allclose(np.asarray(out), 0.2, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(weights[:, 0]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(weights[:, 1:]), 0.0, atol=1e-6)
+
+
+def test_alpha_composition_two_planes():
+    a0, a1 = 0.3, 0.6
+    alpha = jnp.zeros((1, 2, 1, 2, 2)).at[:, 0].set(a0).at[:, 1].set(a1)
+    vals = jnp.stack([jnp.full((1, 1, 2, 2), 1.0), jnp.full((1, 1, 2, 2), 2.0)],
+                     axis=1)
+    out, weights = rendering.alpha_composition(alpha, vals)
+    w0, w1 = a0, (1 - a0) * a1
+    np.testing.assert_allclose(np.asarray(out), w0 * 1.0 + w1 * 2.0, rtol=1e-6)
+
+
+def test_volume_rendering_opaque_first_plane():
+    """sigma -> inf on the first plane: output = plane rgb, depth = plane z."""
+    B, S, H, W = 2, 4, 6, 8
+    depths = [1.0, 2.0, 3.0, 4.0]
+    xyz = make_xyz(B, S, H, W, depths)
+    rgb = jnp.broadcast_to(
+        jnp.asarray([0.1, 0.4, 0.7, 0.9])[None, :, None, None, None],
+        (B, S, 3, H, W))
+    sigma = jnp.zeros((B, S, 1, H, W)).at[:, 0].set(1e4)
+    out, depth, t_acc, w = rendering.plane_volume_rendering(rgb, sigma, xyz, False)
+    np.testing.assert_allclose(np.asarray(out), 0.1, atol=1e-3)
+    # depth is the z of the first plane (== 1.0), weight-normalized
+    np.testing.assert_allclose(np.asarray(depth), 1.0, rtol=1e-3)
+
+
+def test_volume_rendering_transparent():
+    B, S, H, W = 1, 3, 4, 4
+    xyz = make_xyz(B, S, H, W, [1.0, 2.0, 3.0])
+    rgb = jnp.ones((B, S, 3, H, W))
+    sigma = jnp.zeros((B, S, 1, H, W))
+    out, depth, t_acc, w = rendering.plane_volume_rendering(rgb, sigma, xyz, False)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(t_acc[:, 0]), 1.0, atol=1e-5)
+
+
+def torch_plane_volume_rendering(rgb, sigma, xyz):
+    """Direct torch port of the reference formulas (mpi_rendering.py:42-67)."""
+    rgb, sigma, xyz = map(torch.from_numpy, (rgb, sigma, xyz))
+    B, S, _, H, W = sigma.shape
+    diff = xyz[:, 1:] - xyz[:, :-1]
+    dist = torch.norm(diff, dim=2, keepdim=True)
+    dist = torch.cat([dist, torch.full((B, 1, 1, H, W), 1e3)], dim=1)
+    transparency = torch.exp(-sigma * dist)
+    alpha = 1 - transparency
+    t_acc = torch.cumprod(transparency + 1e-6, dim=1)
+    t_acc = torch.cat([torch.ones((B, 1, 1, H, W)), t_acc[:, :-1]], dim=1)
+    weights = t_acc * alpha
+    w_sum = weights.sum(1)
+    rgb_out = (weights * rgb).sum(1)
+    depth_out = (weights * xyz[:, :, 2:3]).sum(1) / (w_sum + 1e-5)
+    return rgb_out.numpy(), depth_out.numpy(), weights.numpy()
+
+
+def test_volume_rendering_matches_torch_port():
+    rng = np.random.RandomState(0)
+    B, S, H, W = 2, 5, 7, 9
+    xyz = np.asarray(make_xyz(B, S, H, W, [1.0, 1.5, 2.0, 3.0, 5.0]))
+    rgb = rng.uniform(size=(B, S, 3, H, W)).astype(np.float32)
+    sigma = rng.uniform(0, 3, size=(B, S, 1, H, W)).astype(np.float32)
+    out, depth, _, w = rendering.plane_volume_rendering(
+        jnp.asarray(rgb), jnp.asarray(sigma), jnp.asarray(xyz), False)
+    t_rgb, t_depth, t_w = torch_plane_volume_rendering(rgb, sigma, xyz)
+    np.testing.assert_allclose(np.asarray(out), t_rgb, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(depth), t_depth, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(w), t_w, rtol=1e-4, atol=1e-5)
+
+
+def test_bg_depth_inf_mode():
+    B, S, H, W = 1, 2, 3, 3
+    xyz = make_xyz(B, S, H, W, [1.0, 2.0])
+    rgb = jnp.ones((B, S, 3, H, W))
+    sigma = jnp.zeros((B, S, 1, H, W))  # fully transparent
+    _, depth, _, _ = rendering.plane_volume_rendering(rgb, sigma, xyz, True)
+    # all weight missing -> background depth ~1000
+    np.testing.assert_allclose(np.asarray(depth), 1000.0, rtol=1e-2)
+
+
+def test_render_tgt_identity_pose_matches_src_render():
+    """Warping with the identity pose must reproduce the source-frame
+    composite (and a full mask of S planes)."""
+    rng = np.random.RandomState(1)
+    B, S, H, W = 1, 4, 8, 12
+    depths = [1.0, 2.0, 4.0, 8.0]
+    disp = jnp.asarray(1.0 / np.asarray(depths, np.float32))[None]
+    K = jnp.asarray([[[15.0, 0, W / 2], [0, 15.0, H / 2], [0, 0, 1]]])
+    K_inv = geometry.inverse_intrinsics(K)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+    xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)
+
+    rgb = jnp.asarray(rng.uniform(size=(B, S, 3, H, W)).astype(np.float32))
+    sigma = jnp.asarray(rng.uniform(0.1, 2, size=(B, S, 1, H, W)).astype(np.float32))
+
+    src_rgb, src_depth, _, _ = rendering.plane_volume_rendering(
+        rgb, sigma, xyz_src, False)
+
+    G = jnp.tile(jnp.eye(4), (B, 1, 1))
+    xyz_tgt = geometry.plane_xyz_tgt(xyz_src, G)
+    res = rendering.render_tgt_rgb_depth(rgb, sigma, disp, xyz_tgt, G, K_inv, K)
+
+    np.testing.assert_allclose(np.asarray(res.rgb), np.asarray(src_rgb),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(res.depth), np.asarray(src_depth),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(res.mask), float(S), atol=1e-6)
+
+
+def test_render_tgt_behind_camera_sigma_zeroed():
+    """Planes behind the target camera (z<0) must not contribute."""
+    B, S, H, W = 1, 2, 4, 4
+    depths = [1.0, 2.0]
+    disp = jnp.asarray(1.0 / np.asarray(depths, np.float32))[None]
+    K = jnp.asarray([[[10.0, 0, 2.0], [0, 10.0, 2.0], [0, 0, 1]]])
+    K_inv = geometry.inverse_intrinsics(K)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+    xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)
+
+    rgb = jnp.ones((B, S, 3, H, W))
+    sigma = jnp.full((B, S, 1, H, W), 1e4)
+
+    # translate the target camera far forward: both planes end up behind it
+    G = jnp.eye(4)[None].at[0, 2, 3].set(-10.0)
+    xyz_tgt = geometry.plane_xyz_tgt(xyz_src, G)
+    res = rendering.render_tgt_rgb_depth(rgb, sigma, disp, xyz_tgt, G, K_inv, K)
+    np.testing.assert_allclose(np.asarray(res.rgb), 0.0, atol=1e-5)
+
+
+def test_render_use_alpha_dispatch():
+    B, S, H, W = 1, 3, 4, 4
+    xyz = make_xyz(B, S, H, W, [1.0, 2.0, 3.0])
+    rgb = jnp.ones((B, S, 3, H, W)) * 0.5
+    alpha = jnp.full((B, S, 1, H, W), 0.5)
+    out, depth, blend, w = rendering.render(rgb, alpha, xyz, use_alpha=True)
+    np.testing.assert_allclose(np.asarray(blend), 0.0)
+    expect = 0.5 * (0.5 + 0.5 * 0.5 + 0.25 * 0.5)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)

@@ -1,0 +1,97 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mine_tpu.ops import sampling
+
+
+def test_stratified_linspace_bins():
+    key = jax.random.PRNGKey(0)
+    B, S = 16, 32
+    start, end = 1.0, 0.001
+    d = np.asarray(sampling.uniformly_sample_disparity_from_linspace_bins(
+        key, B, S, start, end))
+    assert d.shape == (B, S)
+    edges = np.linspace(start, end, S + 1)
+    # every sample falls inside its own bin (edges descending)
+    for s in range(S):
+        assert np.all(d[:, s] <= edges[s] + 1e-6)
+        assert np.all(d[:, s] >= edges[s + 1] - 1e-6)
+    # strictly descending across bins
+    assert np.all(d[:, :-1] > d[:, 1:])
+
+
+def test_stratified_explicit_bins():
+    key = jax.random.PRNGKey(1)
+    edges = np.array([1.0, 0.5, 0.2, 0.05], dtype=np.float32)
+    d = np.asarray(sampling.uniformly_sample_disparity_from_bins(key, 8, edges))
+    assert d.shape == (8, 3)
+    for s in range(3):
+        assert np.all(d[:, s] <= edges[s] + 1e-6)
+        assert np.all(d[:, s] >= edges[s + 1] - 1e-6)
+
+
+def test_fixed_disparity():
+    d = np.asarray(sampling.fixed_disparity_linspace(4, 8, 1.0, 0.1))
+    np.testing.assert_allclose(d[0], np.linspace(1.0, 0.1, 8), rtol=1e-6)
+    assert d.shape == (4, 8)
+
+
+def test_sample_pdf_concentrates_mass():
+    """All weight on one bin -> samples land in that bin's edge interval."""
+    key = jax.random.PRNGKey(2)
+    B, N, S = 2, 1, 8
+    values = jnp.broadcast_to(jnp.linspace(1.0, 0.1, S), (B, 1, N, S))
+    weights = jnp.zeros((B, 1, N, S)).at[..., 3].set(1.0)
+    samples = np.asarray(sampling.sample_pdf(key, values, weights, 64))
+    vals = np.asarray(values)[0, 0, 0]
+    hi = (vals[2] + vals[3]) / 2  # upper edge of bin 3
+    lo = (vals[3] + vals[4]) / 2  # lower edge
+    assert samples.shape == (B, 1, N, 64)
+    assert np.all(samples <= hi + 1e-5)
+    assert np.all(samples >= lo - 1e-5)
+
+
+def test_sample_pdf_uniform_statistics():
+    key = jax.random.PRNGKey(3)
+    B, N, S = 1, 1, 4
+    values = jnp.broadcast_to(jnp.linspace(1.0, 0.0, S), (B, 1, N, S))
+    weights = jnp.ones((B, 1, N, S))
+    samples = np.asarray(sampling.sample_pdf(key, values, weights, 4096))
+    # uniform over [0,1]-ish support: mean ~ 0.5
+    assert abs(samples.mean() - 0.5) < 0.05
+
+
+def test_gather_pixel_by_pxpy():
+    B, C, H, W = 2, 3, 5, 7
+    img = jnp.arange(B * C * H * W, dtype=jnp.float32).reshape(B, C, H, W)
+    pxpy = jnp.asarray([[[0.2, 6.0, -3.0], [0.0, 4.4, 9.0]],
+                        [[1.0, 2.0, 3.0], [1.0, 2.0, 3.0]]])  # [B,2,N]
+    out = np.asarray(sampling.gather_pixel_by_pxpy(img, pxpy))
+    ref = np.asarray(img)
+    # (x=0.2->0, y=0->0): [0,0]; (x=6, y=4.4->4): [4,6]; (x=-3->0, y=9->4): [4,0]
+    np.testing.assert_allclose(out[0, 0], [ref[0, 0, 0, 0], ref[0, 0, 4, 6],
+                                           ref[0, 0, 4, 0]])
+    np.testing.assert_allclose(out[1, 2], [ref[1, 2, 1, 1], ref[1, 2, 2, 2],
+                                           ref[1, 2, 3, 3]])
+
+
+def test_gather_matches_torch_reference():
+    import torch
+
+    rng = np.random.RandomState(0)
+    B, C, H, W, N = 2, 1, 9, 11, 20
+    img = rng.normal(size=(B, C, H, W)).astype(np.float32)
+    pxpy = rng.uniform(-2, 12, size=(B, 2, N)).astype(np.float32)
+
+    ours = np.asarray(sampling.gather_pixel_by_pxpy(jnp.asarray(img),
+                                                    jnp.asarray(pxpy)))
+
+    # direct port of rendering_utils.gather_pixel_by_pxpy (reference :27-44)
+    t_img = torch.from_numpy(img)
+    t_px = torch.round(torch.from_numpy(pxpy)).long()
+    t_px[:, 0].clamp_(0, W - 1)
+    t_px[:, 1].clamp_(0, H - 1)
+    idx = t_px[:, 0:1] + W * t_px[:, 1:2]
+    ref = torch.gather(t_img.view(B, C, H * W), 2, idx.repeat(1, C, 1)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)

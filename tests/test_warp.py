@@ -1,0 +1,116 @@
+"""The bilinear sampler must match torch grid_sample(border,
+align_corners=False) after the reference's grid normalization
+(homography_sampler.py:136-139) — SURVEY.md lists this as hard part #1."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from mine_tpu import geometry
+from mine_tpu.ops import warp
+
+
+def torch_reference_sample(src, x, y):
+    """Exactly the reference's normalize + grid_sample path."""
+    B, C, H, W = src.shape
+    gx = (torch.from_numpy(x) + 0.5) / (W * 0.5) - 1
+    gy = (torch.from_numpy(y) + 0.5) / (H * 0.5) - 1
+    grid = torch.stack([gx, gy], dim=-1)
+    out = F.grid_sample(torch.from_numpy(src), grid=grid,
+                        padding_mode="border", align_corners=False)
+    return out.numpy()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bilinear_sample_matches_torch_grid_sample(seed):
+    rng = np.random.RandomState(seed)
+    B, C, H, W = 3, 7, 13, 17
+    Ho, Wo = 11, 19
+    src = rng.normal(size=(B, C, H, W)).astype(np.float32)
+    # coords spanning in-bounds, out-of-bounds, and exact-boundary cases
+    x = rng.uniform(-4, W + 4, size=(B, Ho, Wo)).astype(np.float32)
+    y = rng.uniform(-4, H + 4, size=(B, Ho, Wo)).astype(np.float32)
+    x[0, 0, 0] = 0.0
+    y[0, 0, 0] = 0.0
+    x[0, 0, 1] = W - 1.0
+    y[0, 0, 1] = H - 1.0
+
+    ours = np.asarray(warp.bilinear_sample(
+        jnp.asarray(src), jnp.asarray(x), jnp.asarray(y)))
+    ref = torch_reference_sample(src, x, y)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_homography_warp_identity():
+    """Identity pose + equal intrinsics must reproduce the source exactly."""
+    rng = np.random.RandomState(3)
+    B, C, H, W = 2, 4, 8, 10
+    src = jnp.asarray(rng.normal(size=(B, C, H, W)).astype(np.float32))
+    K = jnp.asarray([[[50.0, 0, 5.0], [0, 50.0, 4.0], [0, 0, 1]]] * B)
+    G = jnp.tile(jnp.eye(4), (B, 1, 1))
+    d = jnp.full((B,), 3.0)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+
+    out, valid = warp.homography_warp(src, d, G, geometry.inverse_intrinsics(K),
+                                      K, grid)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(src), rtol=1e-4,
+                               atol=1e-4)
+    assert bool(jnp.all(valid))
+
+
+def test_homography_warp_integer_translation():
+    """Camera shift of exactly fx*tx/d = 2 pixels: warped image is the source
+    shifted by 2 pixels, and pixels that sampled outside are invalid."""
+    B, C, H, W = 1, 1, 6, 12
+    fx, d = 10.0, 5.0
+    tx = 1.0  # pixel shift = fx*tx/d = 2
+    img = np.zeros((B, C, H, W), dtype=np.float32)
+    img[0, 0, :, 4] = 1.0
+    K = jnp.asarray([[[fx, 0, W / 2], [0, fx, H / 2], [0, 0, 1]]])
+    G = jnp.eye(4)[None].at[0, 0, 3].set(-tx)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+
+    out, valid = warp.homography_warp(jnp.asarray(img), jnp.asarray([d]), G,
+                                      geometry.inverse_intrinsics(K), K, grid)
+    out = np.asarray(out)
+    # target pixel x sees source pixel x + 2 -> the column lights up at x=2
+    np.testing.assert_allclose(out[0, 0, :, 2], 1.0, atol=1e-5)
+    assert np.abs(out[0, 0, :, 4]).max() < 1e-5
+    # the rightmost two target columns sample source x in [W, W+2) -> invalid
+    v = np.asarray(valid)
+    assert not v[0, :, W - 1].any()
+    assert v[0, :, : W - 2].all()
+
+
+def test_warp_gradients_flow_through_values():
+    """Gradients flow through the sampled *values* (the MPI planes produced by
+    the network). The warp grid itself is deliberately no-grad, matching the
+    reference's no_grad homography inverse (homography_sampler.py:112-113)."""
+    import jax
+
+    B, C, H, W = 1, 2, 5, 5
+    rng = np.random.RandomState(4)
+    src0 = jnp.asarray(rng.normal(size=(B, C, H, W)).astype(np.float32))
+    K = jnp.asarray([[[10.0, 0, 2.0], [0, 10.0, 2.0], [0, 0, 1]]])
+    grid = geometry.pixel_grid_homogeneous(H, W)
+    G = jnp.eye(4)[None].at[0, 0, 3].set(0.13)
+
+    def loss(src):
+        out, _ = warp.homography_warp(src, jnp.asarray([2.0]), G,
+                                      geometry.inverse_intrinsics(K), K, grid)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(src0)
+    g = np.asarray(g)
+    assert np.all(np.isfinite(g)) and np.abs(g).max() > 0
+
+    def loss_t(t):
+        G2 = jnp.eye(4)[None].at[0, 0, 3].set(t)
+        out, _ = warp.homography_warp(src0, jnp.asarray([2.0]), G2,
+                                      geometry.inverse_intrinsics(K), K, grid)
+        return jnp.sum(out ** 2)
+
+    # pose gradient via the grid is intentionally blocked
+    assert float(jax.grad(loss_t)(0.1)) == 0.0

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark: LLFF-config training throughput on the real TPU chip.
+
+Measures the full jitted train step (forward + 4-scale loss + backward +
+two-group Adam) on the north-star config — LLFF 384x256, N=32 planes,
+per-device batch 2, ResNet-50 backbone, bfloat16 conv stacks (BASELINE.md /
+BASELINE.json: "LLFF 384x256 N=32 training at >=4x the V100x2 images/sec").
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+vs_baseline uses the documented V100x2 reference estimate in BASELINE.md
+(ESTIMATED_REFERENCE_IMAGES_PER_SEC below): the repo publishes no measured
+number and this container has no GPU to measure one (SURVEY.md section 6), so
+the denominator is an engineering estimate of the reference's 2xV100 fp32
+throughput at its shipped config — recorded, not guessed silently.
+"""
+
+import json
+import sys
+import time
+
+# Reference estimate: MINE on 2x V100 (B=2/GPU, fp32, 384x256, N=32).
+# See BASELINE.md "Estimated reference throughput" for the derivation.
+ESTIMATED_REFERENCE_IMAGES_PER_SEC = 4.0
+
+BATCH = 2
+HEIGHT, WIDTH = 256, 384
+PLANES = 32
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.config import CONFIG_DIR, load_config
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.train.step import SynthesisTrainer
+
+    import os
+    config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+    config.update({
+        "data.img_h": HEIGHT, "data.img_w": WIDTH,
+        "data.per_gpu_batch_size": BATCH,
+        "mpi.num_bins_coarse": PLANES,
+        "model.num_layers": 50,
+        "training.dtype": "bfloat16",
+    })
+
+    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+    state = trainer.init_state(batch_size=BATCH)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_batch(BATCH, HEIGHT, WIDTH, num_points=256).items()}
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * MEASURE_STEPS / dt
+    result = {
+        "metric": "LLFF 384x256 N=32 train images/sec (1 chip, bf16, ResNet-50)",
+        "value": round(images_per_sec, 3),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / ESTIMATED_REFERENCE_IMAGES_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

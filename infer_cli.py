@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Single-image novel-view video inference — CLI-compatible with the
+reference's visualizations/image_to_video.py.
+
+  python infer_cli.py --checkpoint_path ws/v1/checkpoint_latest \
+      --data_path photo.jpg --output_dir out/
+
+Reads params.yaml next to the checkpoint (reference image_to_video.py:273-278).
+Accepts either an orbax TrainState checkpoint directory or a converted .npz
+weights file (tools/convert_torch_weights.py, including converted MINE
+releases). --gpus is accepted for CLI parity and ignored (device selection is
+JAX's).
+"""
+
+import argparse
+import json
+import os
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Inference")
+    parser.add_argument("--checkpoint_path", type=str, required=True)
+    parser.add_argument("--data_path", type=str, required=True)
+    parser.add_argument("--output_dir", type=str, required=True)
+    parser.add_argument("--gpus", type=str, default=None,
+                        help="ignored (reference-CLI parity)")
+    parser.add_argument("--extra_config", type=str, default="{}")
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import cv2
+    import numpy as np
+    import yaml
+
+    from mine_tpu.config import CONFIG_DIR, load_config, postprocess
+    from mine_tpu.infer.video import VideoGenerator
+    from mine_tpu.train.step import SynthesisTrainer
+    from mine_tpu.utils import make_logger
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = make_logger(os.path.join(args.output_dir, "inference.log"))
+
+    ckpt_dir = os.path.dirname(os.path.abspath(args.checkpoint_path))
+    params_yaml = os.path.join(ckpt_dir, "params.yaml")
+    if os.path.exists(params_yaml):
+        with open(params_yaml) as f:
+            config = postprocess(yaml.safe_load(f))
+        extra = json.loads(args.extra_config)
+        config.update(extra)
+    else:
+        logger.info("No params.yaml next to checkpoint; using LLFF defaults")
+        config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"),
+                             extra_config=args.extra_config)
+
+    # build a state template, then load weights
+    trainer = SynthesisTrainer(config, steps_per_epoch=1)
+    state = trainer.init_state(batch_size=1)
+    params, batch_stats = state.params, state.batch_stats
+
+    if args.checkpoint_path.endswith(".npz"):
+        from mine_tpu.train.checkpoint import load_pretrained_params
+        params, batch_stats = load_pretrained_params(
+            args.checkpoint_path, params, batch_stats, logger)
+    else:
+        from mine_tpu.train.checkpoint import CheckpointManager
+        mgr = CheckpointManager(os.path.dirname(
+            os.path.abspath(args.checkpoint_path)) or ".")
+        restored = mgr.restore(state, os.path.abspath(args.checkpoint_path))
+        if restored is None:
+            raise FileNotFoundError(args.checkpoint_path)
+        params, batch_stats = restored.params, restored.batch_stats
+        logger.info("Restored checkpoint at step %d", int(restored.step))
+
+    img = cv2.imread(args.data_path, cv2.IMREAD_COLOR)
+    if img is None:
+        raise FileNotFoundError(args.data_path)
+    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+
+    gen = VideoGenerator(config, params, batch_stats, img)
+    name = os.path.basename(args.data_path).rsplit(".", 1)[0]
+    written = gen.render_videos(args.output_dir, name)
+    for w in written:
+        logger.info("wrote %s", w)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,9 @@ class MPIConfig:
     # model.*
     pos_encoding_multires: int = 10
     num_layers: int = 50
+    # optional explicit disparity bin edges (S+1 descending values); active
+    # only when its length is num_bins_coarse+1 (synthesis_task.py:36,46)
+    disparity_list: tuple = ()
 
     @property
     def num_bins_total(self) -> int:
@@ -146,4 +149,5 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         img_w=g("data.img_w", 512),
         pos_encoding_multires=g("model.pos_encoding_multires", 10),
         num_layers=g("model.num_layers", 50),
+        disparity_list=tuple(float(d) for d in (g("mpi.disparity_list") or ())),
     )

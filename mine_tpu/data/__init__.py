@@ -1,0 +1,1 @@
+from mine_tpu.data.synthetic import SyntheticMPIDataset, make_batch  # noqa: F401

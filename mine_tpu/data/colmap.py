@@ -1,0 +1,247 @@
+"""COLMAP sparse-model reader (numpy, clean-room from the public format).
+
+Provides what the reference vendors in input_pipelines/colmap_utils.py
+(read_model :420, read_cameras/images/points3d_* :128-418, qvec2rotmat :454):
+cameras / images / points3D from `.bin` or `.txt` sparse models, used
+read-only at dataset init.
+
+Binary layout (COLMAP's documented on-disk format):
+  cameras.bin:  u64 count, then per camera: i32 id, i32 model_id, u64 w, u64 h,
+                f64 params[num_params(model)]
+  images.bin:   u64 count, then per image: i32 id, f64 qvec[4], f64 tvec[3],
+                i32 camera_id, name '\0'-terminated, u64 n_pts,
+                (f64 x, f64 y, i64 point3D_id) * n_pts
+  points3D.bin: u64 count, then per point: i64 id, f64 xyz[3], u8 rgb[3],
+                f64 error, u64 track_len, (i32 image_id, i32 pt2d_idx) * len
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, NamedTuple, Tuple
+
+import numpy as np
+
+
+class Camera(NamedTuple):
+    id: int
+    model: str
+    width: int
+    height: int
+    params: np.ndarray
+
+
+class Image(NamedTuple):
+    id: int
+    qvec: np.ndarray          # [4] (w, x, y, z)
+    tvec: np.ndarray          # [3]
+    camera_id: int
+    name: str
+    xys: np.ndarray           # [N, 2] keypoint pixel coords
+    point3D_ids: np.ndarray   # [N] int64, -1 if untracked
+
+
+class Point3D(NamedTuple):
+    id: int
+    xyz: np.ndarray           # [3]
+    rgb: np.ndarray           # [3] uint8
+    error: float
+    image_ids: np.ndarray
+    point2D_idxs: np.ndarray
+
+
+# model_id -> (name, num_params)
+CAMERA_MODELS = {
+    0: ("SIMPLE_PINHOLE", 3), 1: ("PINHOLE", 4), 2: ("SIMPLE_RADIAL", 4),
+    3: ("RADIAL", 5), 4: ("OPENCV", 8), 5: ("OPENCV_FISHEYE", 8),
+    6: ("FULL_OPENCV", 12), 7: ("FOV", 5), 8: ("SIMPLE_RADIAL_FISHEYE", 4),
+    9: ("RADIAL_FISHEYE", 5), 10: ("THIN_PRISM_FISHEYE", 12),
+}
+CAMERA_MODEL_IDS = {name: (mid, n) for mid, (name, n) in CAMERA_MODELS.items()}
+
+
+def qvec2rotmat(qvec: np.ndarray) -> np.ndarray:
+    """Unit quaternion (w,x,y,z) -> 3x3 rotation matrix."""
+    w, x, y, z = qvec
+    return np.array([
+        [1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y)],
+        [2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x)],
+        [2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y)],
+    ])
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def take(self, fmt: str):
+        size = struct.calcsize(fmt)
+        out = struct.unpack_from(fmt, self.data, self.pos)
+        self.pos += size
+        return out
+
+    def take_string(self) -> str:
+        end = self.data.index(b"\x00", self.pos)
+        s = self.data[self.pos:end].decode("utf-8")
+        self.pos = end + 1
+        return s
+
+
+def read_cameras_binary(path: str) -> Dict[int, Camera]:
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    (n,) = r.take("<Q")
+    cameras = {}
+    for _ in range(n):
+        cam_id, model_id, width, height = r.take("<iiQQ")
+        name, n_params = CAMERA_MODELS[model_id]
+        params = np.array(r.take(f"<{n_params}d"))
+        cameras[cam_id] = Camera(cam_id, name, width, height, params)
+    return cameras
+
+
+def read_images_binary(path: str) -> Dict[int, Image]:
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    (n,) = r.take("<Q")
+    images = {}
+    for _ in range(n):
+        (img_id,) = r.take("<i")
+        qvec = np.array(r.take("<4d"))
+        tvec = np.array(r.take("<3d"))
+        (cam_id,) = r.take("<i")
+        name = r.take_string()
+        (n_pts,) = r.take("<Q")
+        raw = np.frombuffer(r.data, dtype=np.dtype("<f8,<f8,<i8"),
+                            count=n_pts, offset=r.pos)
+        r.pos += 24 * n_pts
+        xys = np.stack([raw["f0"], raw["f1"]], axis=1) if n_pts else np.zeros((0, 2))
+        ids = raw["f2"].astype(np.int64) if n_pts else np.zeros((0,), np.int64)
+        images[img_id] = Image(img_id, qvec, tvec, cam_id, name, xys, ids)
+    return images
+
+
+def read_points3d_binary(path: str) -> Dict[int, Point3D]:
+    with open(path, "rb") as f:
+        r = _Reader(f.read())
+    (n,) = r.take("<Q")
+    points = {}
+    for _ in range(n):
+        (pid,) = r.take("<q")
+        xyz = np.array(r.take("<3d"))
+        rgb = np.array(r.take("<3B"), dtype=np.uint8)
+        (error,) = r.take("<d")
+        (track_len,) = r.take("<Q")
+        track = np.frombuffer(r.data, dtype="<i4", count=2 * track_len,
+                              offset=r.pos).reshape(-1, 2)
+        r.pos += 8 * track_len
+        points[pid] = Point3D(pid, xyz, rgb, error,
+                              track[:, 0].copy(), track[:, 1].copy())
+    return points
+
+
+def read_cameras_text(path: str) -> Dict[int, Camera]:
+    cameras = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            cam_id = int(parts[0])
+            model = parts[1]
+            cameras[cam_id] = Camera(cam_id, model, int(parts[2]), int(parts[3]),
+                                     np.array([float(p) for p in parts[4:]]))
+    return cameras
+
+
+def read_images_text(path: str) -> Dict[int, Image]:
+    images = {}
+    with open(path) as f:
+        lines = [l.strip() for l in f
+                 if l.strip() and not l.strip().startswith("#")]
+    for i in range(0, len(lines), 2):
+        parts = lines[i].split()
+        img_id = int(parts[0])
+        qvec = np.array([float(p) for p in parts[1:5]])
+        tvec = np.array([float(p) for p in parts[5:8]])
+        cam_id = int(parts[8])
+        name = parts[9]
+        pts = lines[i + 1].split() if i + 1 < len(lines) else []
+        trip = np.array([float(p) for p in pts]).reshape(-1, 3) if pts else \
+            np.zeros((0, 3))
+        images[img_id] = Image(img_id, qvec, tvec, cam_id, name,
+                               trip[:, :2], trip[:, 2].astype(np.int64))
+    return images
+
+
+def read_points3d_text(path: str) -> Dict[int, Point3D]:
+    points = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            pid = int(parts[0])
+            xyz = np.array([float(p) for p in parts[1:4]])
+            rgb = np.array([int(p) for p in parts[4:7]], dtype=np.uint8)
+            error = float(parts[7])
+            track = np.array([int(p) for p in parts[8:]]).reshape(-1, 2) \
+                if len(parts) > 8 else np.zeros((0, 2), np.int64)
+            points[pid] = Point3D(pid, xyz, rgb, error,
+                                  track[:, 0], track[:, 1])
+    return points
+
+
+def read_model(path: str, ext: str = ".bin") -> Tuple[Dict, Dict, Dict]:
+    """Load (cameras, images, points3D) from a COLMAP sparse dir.
+
+    Same entry point shape as the reference's colmap_utils.read_model(:420).
+    """
+    if ext == ".bin":
+        cameras = read_cameras_binary(os.path.join(path, "cameras.bin"))
+        images = read_images_binary(os.path.join(path, "images.bin"))
+        points3d = read_points3d_binary(os.path.join(path, "points3D.bin"))
+    elif ext == ".txt":
+        cameras = read_cameras_text(os.path.join(path, "cameras.txt"))
+        images = read_images_text(os.path.join(path, "images.txt"))
+        points3d = read_points3d_text(os.path.join(path, "points3D.txt"))
+    else:
+        raise ValueError(f"unknown model extension {ext}")
+    return cameras, images, points3d
+
+
+def write_model_binary(path: str, cameras: Dict[int, Camera],
+                       images: Dict[int, Image],
+                       points3d: Dict[int, Point3D]) -> None:
+    """Write a sparse model in binary format (round-trip tests / tooling)."""
+    with open(os.path.join(path, "cameras.bin"), "wb") as f:
+        f.write(struct.pack("<Q", len(cameras)))
+        for cam in cameras.values():
+            model_id, n_params = CAMERA_MODEL_IDS[cam.model]
+            f.write(struct.pack("<iiQQ", cam.id, model_id, cam.width, cam.height))
+            f.write(struct.pack(f"<{n_params}d", *cam.params[:n_params]))
+    with open(os.path.join(path, "images.bin"), "wb") as f:
+        f.write(struct.pack("<Q", len(images)))
+        for img in images.values():
+            f.write(struct.pack("<i", img.id))
+            f.write(struct.pack("<4d", *img.qvec))
+            f.write(struct.pack("<3d", *img.tvec))
+            f.write(struct.pack("<i", img.camera_id))
+            f.write(img.name.encode("utf-8") + b"\x00")
+            f.write(struct.pack("<Q", len(img.xys)))
+            for xy, pid in zip(img.xys, img.point3D_ids):
+                f.write(struct.pack("<ddq", xy[0], xy[1], int(pid)))
+    with open(os.path.join(path, "points3D.bin"), "wb") as f:
+        f.write(struct.pack("<Q", len(points3d)))
+        for pt in points3d.values():
+            f.write(struct.pack("<q", pt.id))
+            f.write(struct.pack("<3d", *pt.xyz))
+            f.write(struct.pack("<3B", *pt.rgb))
+            f.write(struct.pack("<d", pt.error))
+            f.write(struct.pack("<Q", len(pt.image_ids)))
+            for iid, pidx in zip(pt.image_ids, pt.point2D_idxs):
+                f.write(struct.pack("<ii", int(iid), int(pidx)))

@@ -24,17 +24,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def pixel_grid_homogeneous(height: int, width: int) -> jnp.ndarray:
+def pixel_grid_homogeneous(height: int, width: int) -> np.ndarray:
     """Homogeneous pixel-center grid, shape [3, H, W] rows (x, y, 1).
 
     Matches reference HomographySample.grid_generation
     (homography_sampler.py:24-33): x in [0, W-1], y in [0, H-1].
+
+    Returned as numpy (not jnp) on purpose: callers may run under different
+    jit traces, and a host-cached numpy constant embeds safely in each —
+    whereas a cached jnp array created inside one trace would leak its tracer
+    into the next.
     """
     x = np.arange(width, dtype=np.float32)
     y = np.arange(height, dtype=np.float32)
     xv, yv = np.meshgrid(x, y)  # HxW each
-    grid = np.stack([xv, yv, np.ones_like(xv)], axis=0)  # 3xHxW
-    return jnp.asarray(grid)
+    return np.stack([xv, yv, np.ones_like(xv)], axis=0)  # 3xHxW
 
 
 def inverse_3x3(mat: jnp.ndarray) -> jnp.ndarray:
@@ -182,6 +186,6 @@ def intrinsics_from_fov(height: int, width: int, fov_degrees: float = 90.0) -> n
 
 
 @functools.lru_cache(maxsize=None)
-def cached_pixel_grid(height: int, width: int):
-    """Host-cached meshgrid; becomes an XLA constant when closed over by jit."""
+def cached_pixel_grid(height: int, width: int) -> np.ndarray:
+    """Host-cached numpy meshgrid; becomes an XLA constant in each jit trace."""
     return pixel_grid_homogeneous(height, width)

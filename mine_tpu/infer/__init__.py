@@ -1,0 +1,1 @@
+from mine_tpu.infer.video import VideoGenerator, path_planning  # noqa: F401

@@ -1,0 +1,3 @@
+from mine_tpu.losses.photometric import (edge_aware_loss, edge_aware_loss_v2,  # noqa: F401
+                                         psnr)
+from mine_tpu.losses.ssim import ssim  # noqa: F401

@@ -1,0 +1,104 @@
+"""LPIPS perceptual metric (VGG16 backbone), eval-only.
+
+Reference usage: synthesis_task.py:91-92,341-344 — `lpips.LPIPS(net="vgg")`
+evaluated at scale 0 during validation, rank-0 only. The reference feeds
+images in [0,1] without the package's `normalize=True` flag (i.e. the inputs
+are NOT remapped to [-1,1]); we reproduce that behavior exactly for metric
+parity.
+
+Architecture (per the public LPIPS formulation):
+  scaling layer -> VGG16 features at relu1_2/relu2_2/relu3_3/relu4_3/relu5_3
+  -> unit-normalize channels -> squared diff -> 1x1 non-negative linear head
+  -> spatial mean -> sum over the 5 taps.
+
+This container has no network egress and no pretrained weights, so the module
+is *gated*: `load_params(path)` loads weights converted offline by
+tools/convert_torch_weights.py (from torchvision vgg16 + the lpips package's
+linear heads); without a weights file, `available()` is False and the eval
+harness reports lpips as NaN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# VGG16 conv plan: (features, num_convs) per block; taps after each block's relu
+_VGG_PLAN: Tuple[Tuple[int, int], ...] = (
+    (64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+# LPIPS scaling layer constants (public lpips implementation)
+_SHIFT = np.array([-0.030, -0.088, -0.188], dtype=np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], dtype=np.float32)
+
+
+def _conv(x, w, b):
+    """3x3 SAME conv, NHWC, HWIO kernel."""
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + b
+
+
+def _vgg_features(params: Dict[str, jnp.ndarray], x: jnp.ndarray) -> List[jnp.ndarray]:
+    """Run VGG16 conv stack, returning the 5 relu taps. x: [B,H,W,3]."""
+    taps = []
+    idx = 0
+    for block, (feat, n_convs) in enumerate(_VGG_PLAN):
+        for c in range(n_convs):
+            x = jax.nn.relu(_conv(x, params[f"conv{idx}_w"], params[f"conv{idx}_b"]))
+            idx += 1
+        taps.append(x)
+        if block < len(_VGG_PLAN) - 1:
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    return taps
+
+
+def _unit_normalize(x: jnp.ndarray, eps: float = 1e-10) -> jnp.ndarray:
+    norm = jnp.sqrt(jnp.sum(x ** 2, axis=-1, keepdims=True))
+    return x / (norm + eps)
+
+
+def lpips_distance(params: Dict[str, jnp.ndarray],
+                   img1: jnp.ndarray, img2: jnp.ndarray) -> jnp.ndarray:
+    """LPIPS distance per batch element.
+
+    Args:
+      params: dict with conv{i}_w/b (HWIO/bias) and lin{k}_w ([C] non-negative)
+      img1, img2: [B, 3, H, W] (rendering-domain layout), values as-fed by the
+        caller (the reference feeds [0,1] without remapping).
+    Returns: [B]
+    """
+    def prep(img):
+        x = jnp.transpose(img, (0, 2, 3, 1))  # NHWC
+        return (x - jnp.asarray(_SHIFT)) / jnp.asarray(_SCALE)
+
+    taps1 = _vgg_features(params, prep(img1))
+    taps2 = _vgg_features(params, prep(img2))
+
+    total = 0.0
+    for k, (t1, t2) in enumerate(zip(taps1, taps2)):
+        d = (_unit_normalize(t1) - _unit_normalize(t2)) ** 2  # [B,h,w,C]
+        w = params[f"lin{k}_w"]  # [C]
+        total = total + jnp.mean(jnp.sum(d * w, axis=-1), axis=(1, 2))
+    return total
+
+
+def load_params(path: str) -> Optional[Dict[str, jnp.ndarray]]:
+    """Load converted LPIPS weights (.npz). Returns None if missing."""
+    if not path or not os.path.exists(path):
+        return None
+    data = np.load(path)
+    return {k: jnp.asarray(data[k]) for k in data.files}
+
+
+def default_weights_path() -> str:
+    return os.environ.get(
+        "MINE_TPU_LPIPS_WEIGHTS",
+        os.path.join(os.path.dirname(__file__), "..", "..", "weights",
+                     "lpips_vgg.npz"))

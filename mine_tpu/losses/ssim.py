@@ -1,0 +1,69 @@
+"""Gaussian-window SSIM.
+
+Reference: network/ssim.py — 11x11 window, sigma 1.5, per-channel grouped
+conv with padding window//2, C1=0.01^2, C2=0.03^2, biased local variances.
+The training loss uses 1 - ssim (synthesis_task.py:303,338).
+
+Implemented as a depthwise NHWC convolution (single XLA conv per moment,
+fuses cleanly); inputs are [B, C, H, W] float in [0, 1] to match the
+rendering-domain layout.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _gaussian_window(window_size: int, sigma: float) -> np.ndarray:
+    x = np.arange(window_size, dtype=np.float64) - window_size // 2
+    g = np.exp(-(x ** 2) / (2.0 * sigma ** 2))
+    g = g / g.sum()
+    w2d = np.outer(g, g).astype(np.float32)
+    return w2d  # [k, k]
+
+
+def _depthwise_blur(x_nhwc: jnp.ndarray, window: jnp.ndarray) -> jnp.ndarray:
+    C = x_nhwc.shape[-1]
+    k = window.shape[0]
+    kern = jnp.broadcast_to(window[:, :, None, None], (k, k, 1, C))
+    pad = k // 2
+    return jax.lax.conv_general_dilated(
+        x_nhwc, kern,
+        window_strides=(1, 1),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=C)
+
+
+def ssim(img1: jnp.ndarray, img2: jnp.ndarray,
+         window_size: int = 11, sigma: float = 1.5,
+         size_average: bool = True) -> jnp.ndarray:
+    """SSIM between [B, C, H, W] images. Returns a scalar (size_average) or
+    per-image [B] means."""
+    x = jnp.transpose(img1, (0, 2, 3, 1))
+    y = jnp.transpose(img2, (0, 2, 3, 1))
+    window = jnp.asarray(_gaussian_window(window_size, sigma))
+
+    mu1 = _depthwise_blur(x, window)
+    mu2 = _depthwise_blur(y, window)
+    mu1_sq = mu1 * mu1
+    mu2_sq = mu2 * mu2
+    mu1_mu2 = mu1 * mu2
+
+    sigma1_sq = _depthwise_blur(x * x, window) - mu1_sq
+    sigma2_sq = _depthwise_blur(y * y, window) - mu2_sq
+    sigma12 = _depthwise_blur(x * y, window) - mu1_mu2
+
+    c1 = 0.01 ** 2
+    c2 = 0.03 ** 2
+    ssim_map = ((2 * mu1_mu2 + c1) * (2 * sigma12 + c2)) / (
+        (mu1_sq + mu2_sq + c1) * (sigma1_sq + sigma2_sq + c2))
+
+    if size_average:
+        return jnp.mean(ssim_map)
+    return jnp.mean(ssim_map, axis=(1, 2, 3))

@@ -125,7 +125,8 @@ class ConvBlock(nn.Module):
     def __call__(self, x, train: bool):
         x = Conv(self.features, 3, pad_mode="reflect", dtype=self.dtype,
                  name="conv3x3")(x)
-        x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype,
+                      name="bn")(x)
         return nn.elu(x)
 
 
@@ -142,5 +143,6 @@ class ConvBNLeaky(nn.Module):
     def __call__(self, x, train: bool):
         x = Conv(self.features, self.kernel_size, use_bias=False,
                  dtype=self.dtype, name="conv")(x)
-        x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype,
+                      name="bn")(x)
         return nn.leaky_relu(x, negative_slope=0.1)

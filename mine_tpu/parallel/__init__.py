@@ -1,0 +1,2 @@
+from mine_tpu.parallel.mesh import (batch_sharding, constrain, make_mesh,  # noqa: F401
+                                    replicated)

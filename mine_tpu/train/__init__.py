@@ -1,0 +1,2 @@
+from mine_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from mine_tpu.train.step import SynthesisTrainer  # noqa: F401

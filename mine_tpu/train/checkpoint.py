@@ -1,0 +1,121 @@
+"""Orbax checkpointing of the full train state.
+
+Fixes the reference's resume gaps (SURVEY.md section 5): the reference saves
+only {backbone, decoder, optimizer} state dicts — no step/epoch, no RNG, and
+eval-interval checkpoints even omit the optimizer (synthesis_task.py:625-659)
+— so resume restarts counters and reshuffles data. Here the whole TrainState
+(params, batch_stats, opt_state, step, rng) round-trips, and saves are async
+so the TPU never waits on the filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from mine_tpu.train.state import TrainState
+
+LATEST_NAME = "checkpoint_latest"
+STEP_FMT = "checkpoint_%012d"
+
+
+class CheckpointManager:
+    def __init__(self, workspace: str):
+        self.workspace = os.path.abspath(workspace)
+        os.makedirs(self.workspace, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.workspace, name)
+
+    def save_latest(self, state: TrainState):
+        """Rolling checkpoint (reference: checkpoint_latest.pth every 5000
+        steps, synthesis_task.py:625-632)."""
+        path = self._path(LATEST_NAME)
+        self._ckptr.save(path, state, force=True)
+
+    def save_step(self, state: TrainState):
+        """Immutable per-eval checkpoint — unlike the reference's, it keeps
+        the optimizer state (synthesis_task.py:650-652 drops it)."""
+        path = self._path(STEP_FMT % int(state.step))
+        if not os.path.exists(path):
+            self._ckptr.save(path, state)
+
+    def wait(self):
+        self._ckptr.wait_until_finished()
+
+    def restore(self, template: TrainState,
+                name: Optional[str] = None) -> Optional[TrainState]:
+        """Restore into the template's structure/shardings; returns None when
+        no checkpoint exists."""
+        name = name or LATEST_NAME
+        path = name if os.path.isabs(name) else self._path(name)
+        if not os.path.exists(path):
+            return None
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          template)
+        return self._ckptr.restore(path, abstract)
+
+    def latest_exists(self) -> bool:
+        return os.path.exists(self._path(LATEST_NAME))
+
+
+def load_pretrained_params(path: str, params, batch_stats=None, logger=None):
+    """Non-strict restore from a converted .npz checkpoint (flattened 'a/b/c'
+    keys; BatchNorm running stats under 'stats:a/b/c') — the torch-interop
+    path, mirroring restore_model's tolerant model load (utils.py:40-67).
+
+    Missing/extra keys are logged, matching keys replaced. Returns new params
+    (and new batch_stats when a template is given).
+    """
+    data = np.load(path)
+
+    def merge(tree, prefix_tag, tag):
+        flat = _flatten("", tree)
+        missing = [k for k in flat if prefix_tag + k not in data]
+        if logger:
+            logger.info("[MODEL_RESTORE] %s keys missing in checkpoint: %s",
+                        tag, missing)
+
+        def rebuild(prefix, t):
+            out = {}
+            for k, v in t.items():
+                key = f"{prefix}/{k}" if prefix else k
+                if isinstance(v, dict):
+                    out[k] = rebuild(key, v)
+                elif prefix_tag + key in data:
+                    arr = np.asarray(data[prefix_tag + key])
+                    out[k] = arr.astype(np.asarray(v).dtype).reshape(v.shape)
+                else:
+                    out[k] = v
+            return out
+
+        return rebuild("", tree)
+
+    new_params = merge(params, "", "param")
+    if logger:
+        known = set(_flatten("", params))
+        if batch_stats is not None:
+            known |= {"stats:" + k for k in _flatten("", batch_stats)}
+        extra = [k for k in data.files
+                 if k not in known and not (k.startswith("stats:")
+                                            and batch_stats is None)]
+        logger.info("[MODEL_RESTORE] unused checkpoint keys: %s", extra)
+    if batch_stats is None:
+        return new_params
+    return new_params, merge(batch_stats, "stats:", "batch_stats")
+
+
+def _flatten(prefix, tree):
+    flat = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            flat.update(_flatten(key, v))
+        else:
+            flat[key] = v
+    return flat

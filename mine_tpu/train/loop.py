@@ -1,0 +1,199 @@
+"""Host training loop: epochs, logging, eval cadence, checkpointing.
+
+The driver half of the reference's SynthesisTask.train/train_epoch/run_eval
+(synthesis_task.py:476-670) — same cadences (log every 10 steps, rolling
+checkpoint every 5000, eval at step 2000 and every eval_interval with a step
+checkpoint), same meters and tensorboard tags, but:
+  * the whole step is one jitted call; the loop only feeds batches and logs
+  * checkpoints carry step+RNG (resume is exact; reference restarts counters)
+  * rank gating is jax.process_index()==0 (multi-host single-controller)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mine_tpu.train.checkpoint import CheckpointManager
+from mine_tpu.train.state import TrainState, current_lrs
+from mine_tpu.train.step import SynthesisTrainer
+from mine_tpu.utils import AverageMeter, disparity_normalization_vis, metrics_to_float
+
+TRAIN_METER_KEYS = ("loss", "loss_rgb_src", "loss_ssim_src",
+                    "loss_disp_pt3dsrc", "loss_rgb_tgt", "loss_ssim_tgt",
+                    "lpips_tgt", "psnr_tgt", "loss_disp_pt3dtgt")
+
+
+class TrainLoop:
+    def __init__(self, trainer: SynthesisTrainer,
+                 train_dataset, val_dataset,
+                 workspace: str,
+                 logger=None,
+                 tb_writer=None):
+        self.trainer = trainer
+        self.config = trainer.config
+        self.train_dataset = train_dataset
+        self.val_dataset = val_dataset
+        self.logger = logger
+        self.tb = tb_writer
+        self.ckpt = CheckpointManager(workspace)
+
+        self.is_lead = jax.process_index() == 0
+        self.train_meters = {k: AverageMeter("train_" + k)
+                             for k in TRAIN_METER_KEYS}
+        self.val_meters = {k: AverageMeter("val_" + k)
+                           for k in TRAIN_METER_KEYS}
+
+        self.log_interval = int(self.config.get("training.log_interval", 10))
+        self.ckpt_interval = int(self.config.get("training.checkpoint_interval", 5000))
+        self.eval_interval = int(self.config.get("training.eval_interval", 10000))
+        # per-host examples per step (per_gpu_batch_size x data-axis devices,
+        # split across hosts); the jitted step sees the global batch
+        self.local_batch_size = trainer.local_batch_size()
+        self.seed = int(self.config.get("training.seed", 0))
+
+    # ---------------- top-level ----------------
+
+    def run(self, state: Optional[TrainState] = None,
+            epochs: Optional[int] = None) -> TrainState:
+        if state is None:
+            state = self.trainer.init_state(self.trainer.global_batch_size())
+            restored = self.ckpt.restore(state)
+            if restored is not None:
+                state = restored
+                self._log("Resumed from checkpoint at step %d" % int(state.step))
+
+        epochs = epochs or int(self.config.get("training.epochs", 1))
+        steps_per_epoch = self.trainer.steps_per_epoch
+        start_epoch = int(state.step) // steps_per_epoch + 1
+
+        for epoch in range(start_epoch, epochs + 1):
+            state = self.train_epoch(state, epoch)
+            if self.is_lead:
+                self._log("Epoch %d finished, average losses:" % epoch)
+                for m in self.train_meters.values():
+                    self._log("    %s" % m)
+        self.ckpt.wait()
+        return state
+
+    # ---------------- epoch ----------------
+
+    def train_epoch(self, state: TrainState, epoch: int) -> TrainState:
+        for m in self.train_meters.values():
+            m.reset()
+
+        it = self.train_dataset.batch_iterator(
+            batch_size=self.local_batch_size,
+            shuffle=True,
+            seed=self.seed,
+            epoch=epoch,
+            drop_last=True,
+            shard_index=jax.process_index(),
+            num_shards=jax.process_count())
+
+        step_in_epoch = 0
+        t_last = time.perf_counter()
+        for np_batch in it:
+            batch = self.trainer.put_batch(np_batch)
+            state, metrics = self.trainer.train_step(state, batch)
+            step_in_epoch += 1
+            gstep = int(state.step)
+
+            if step_in_epoch % self.log_interval == 0 and self.is_lead:
+                m = metrics_to_float(metrics)
+                dt = (time.perf_counter() - t_last) / self.log_interval
+                t_last = time.perf_counter()
+                self._log_training(epoch, step_in_epoch, gstep, m, dt)
+
+            # checkpoint saves and eval are collective over the mesh: EVERY
+            # process participates (orbax + jit would deadlock otherwise);
+            # only logging/TB writes are lead-gated.
+            if gstep > 0 and gstep % self.ckpt_interval == 0:
+                self.ckpt.save_latest(state)
+                self._log("Latest checkpoint saved at step %d" % gstep)
+
+            if gstep > 0 and (gstep == 2000 or gstep % self.eval_interval == 0) \
+                    and self.val_dataset is not None:
+                self.run_eval(state)
+                self.ckpt.save_step(state)
+        return state
+
+    # ---------------- eval ----------------
+
+    def run_eval(self, state: TrainState) -> Dict[str, float]:
+        """Full-val-set evaluation (synthesis_task.run_eval :476-507)."""
+        self._log("Start running evaluation on validation set:")
+        for m in self.val_meters.values():
+            m.reset()
+
+        it = self.val_dataset.batch_iterator(
+            batch_size=self.local_batch_size, shuffle=False, drop_last=False,
+            shard_index=jax.process_index(), num_shards=jax.process_count())
+        eval_rng = jax.random.PRNGKey(0)
+        gstep = int(state.step)
+        for i, np_batch in enumerate(it):
+            if np_batch["src_img"].shape[0] != self.local_batch_size:
+                continue  # jit shape stability; reference drops via batching too
+            batch = self.trainer.put_batch(np_batch)
+            metrics, visuals = self.trainer.eval_step(
+                state, batch, jax.random.fold_in(eval_rng, i))
+            m = metrics_to_float(metrics)
+            for k, meter in self.val_meters.items():
+                meter.update(m[k], n=self.local_batch_size)
+            if i == 0 and self.tb is not None:
+                self._log_val_images(gstep, batch, visuals)
+
+        self._log("Evaluation finished, average losses:")
+        for m in self.val_meters.values():
+            self._log("    %s" % m)
+        if self.tb is not None:
+            for k, meter in self.val_meters.items():
+                self.tb.add_scalar(k + "/val", meter.avg, gstep)
+        return {k: meter.avg for k, meter in self.val_meters.items()}
+
+    # ---------------- logging ----------------
+
+    def _log(self, msg, *args):
+        if self.logger is not None and self.is_lead:
+            self.logger.info(msg, *args)
+
+    def _log_training(self, epoch, step, gstep, m, step_time):
+        lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
+        self._log(
+            "epoch [%.3d] step [%d] global_step = %d total_loss = %.4f "
+            "encoder_lr = %.7f step_time = %.3fs\n"
+            "        src: rgb = %.4f ssim = %.4f disp_pt3d = %.4f\n"
+            "        tgt: rgb = %.4f ssim = %.4f disp_pt3d = %.4f psnr = %.2f"
+            % (epoch, step, gstep, m["loss"], lrs["backbone"], step_time,
+               m["loss_rgb_src"], m["loss_ssim_src"], m["loss_disp_pt3dsrc"],
+               m["loss_rgb_tgt"], m["loss_ssim_tgt"], m["loss_disp_pt3dtgt"],
+               m["psnr_tgt"]))
+        for k, meter in self.train_meters.items():
+            meter.update(m[k])
+            if self.tb is not None:
+                self.tb.add_scalar(k + "/train", m[k], gstep)
+
+    def _log_val_images(self, gstep, batch, visuals):
+        """Tensorboard image grids (synthesis_task.log_val :509-548)."""
+        def grid(x_bchw):
+            x = np.asarray(x_bchw)
+            return np.clip(np.concatenate(list(x), axis=2), 0.0, 1.0)
+
+        src = np.transpose(np.asarray(batch["src_img"]), (0, 3, 1, 2))
+        tgt = np.transpose(np.asarray(batch["tgt_img"]), (0, 3, 1, 2))
+        self.tb.add_image("00_src_images", grid(src), gstep)
+        self.tb.add_image("01_gt_tgt_images", grid(tgt), gstep)
+        self.tb.add_image("02_syn_src_images/step_%d" % gstep,
+                          grid(visuals["src_imgs_syn"]), gstep)
+        self.tb.add_image("03_syn_src_disparity_map/step_%d" % gstep,
+                          grid(disparity_normalization_vis(
+                              np.asarray(visuals["src_disparity_syn"]))), gstep)
+        self.tb.add_image("04_syn_tgt_images/step_%d" % gstep,
+                          grid(visuals["tgt_imgs_syn"]), gstep)
+        self.tb.add_image("05_syn_tgt_disparity_map/step_%d" % gstep,
+                          grid(disparity_normalization_vis(
+                              np.asarray(visuals["tgt_disparity_syn"]))), gstep)

@@ -1,0 +1,92 @@
+"""Train state + optimizer.
+
+Optimizer semantics match the reference (synthesis_task.py:83-87,116-118):
+Adam with L2 weight decay folded into the gradient *before* the moment
+updates (torch.optim.Adam's weight_decay), two parameter groups with separate
+learning rates (backbone vs decoder), and a MultiStepLR schedule that decays
+both by gamma at epoch milestones.
+
+Unlike the reference's checkpoints — which drop step/epoch and RNG
+(synthesis_task.py:629-631,650-652; SURVEY.md section 5) — the state carries
+step and the PRNG key, so checkpoint/resume is exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # int32 scalar
+    params: Any                # {'backbone': ..., 'decoder': ...}
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array             # folded with step per training step
+
+
+def multistep_lr(base_lr: float, decay_epochs, gamma: float,
+                 steps_per_epoch: int) -> optax.Schedule:
+    """MultiStepLR: multiply by gamma at each epoch milestone."""
+    boundaries = {int(e) * int(steps_per_epoch): gamma for e in decay_epochs}
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def make_optimizer(config: Dict[str, Any], steps_per_epoch: int) -> optax.GradientTransformation:
+    """Two-group Adam(+L2) with MultiStepLR, matching the reference groups
+    {backbone: lr.backbone_lr, decoder: lr.decoder_lr} and lr.weight_decay."""
+    wd = float(config.get("lr.weight_decay", 0.0))
+    gamma = float(config.get("lr.decay_gamma", 0.1))
+    decay_epochs = config.get("lr.decay_steps", [])
+
+    def group(base_lr: float) -> optax.GradientTransformation:
+        return optax.chain(
+            optax.add_decayed_weights(wd),
+            optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
+            optax.scale_by_learning_rate(
+                multistep_lr(base_lr, decay_epochs, gamma, steps_per_epoch)),
+        )
+
+    def label_fn(params):
+        return {k: k for k in params}  # top-level keys: backbone / decoder
+
+    return optax.multi_transform(
+        {"backbone": group(float(config["lr.backbone_lr"])),
+         "decoder": group(float(config["lr.decoder_lr"]))},
+        label_fn)
+
+
+def create_train_state(model, config: Dict[str, Any], steps_per_epoch: int,
+                       sample_img, sample_disparity, seed: int = 0) -> TrainState:
+    """Initialize params/batch_stats and the optimizer state."""
+    init_key, state_key = jax.random.split(jax.random.PRNGKey(seed))
+    variables = model.init(init_key, sample_img, sample_disparity, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = make_optimizer(config, steps_per_epoch)
+    opt_state = tx.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32),
+                      params=params,
+                      batch_stats=batch_stats,
+                      opt_state=opt_state,
+                      rng=state_key)
+
+
+def current_lrs(config: Dict[str, Any], steps_per_epoch: int, step: int):
+    """Host-side LR readback for logging (reference logs encoder lr,
+    synthesis_task.py:572)."""
+    gamma = float(config.get("lr.decay_gamma", 0.1))
+    decay_epochs = config.get("lr.decay_steps", [])
+    lrs = {}
+    for name, key in (("backbone", "lr.backbone_lr"), ("decoder", "lr.decoder_lr")):
+        lr = float(config[key])
+        for e in decay_epochs:
+            if step >= int(e) * steps_per_epoch:
+                lr *= gamma
+        lrs[name] = lr
+    return lrs

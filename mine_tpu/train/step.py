@@ -1,0 +1,218 @@
+"""Jitted train/eval steps over a device mesh.
+
+One `train_step` = forward (encoder + disparity-conditioned decoder, with
+optional coarse-to-fine), all 4 loss scales, backward, and the two-group Adam
+update — a single XLA program (the reference runs this as separate eager
+stages, synthesis_task.py:604-615). Data parallelism is the sharded batch
+axis; the gradient all-reduce the reference got from DDP and the SyncBN
+statistics both fall out of GSPMD on the ("data", "plane") mesh.
+
+RNG: the reference samples disparities with unseeded global RNG per step
+(rendering_utils.py:86); here every step folds the state's PRNG key with the
+step counter — reproducible and resumable by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mine_tpu import geometry
+from mine_tpu.config import MPIConfig, mpi_config_from_dict
+from mine_tpu.models.mpi import MPIPredictor
+from mine_tpu.ops import rendering, sampling
+from mine_tpu.parallel import mesh as mesh_lib
+from mine_tpu.train.loss import compute_losses
+from mine_tpu.train.state import TrainState, create_train_state, make_optimizer
+
+
+def sample_disparity(key: jax.Array, batch_size: int, cfg: MPIConfig) -> jnp.ndarray:
+    """Coarse plane disparities for one step (synthesis_task._get_disparity_list
+    :31-60): stratified per-bin samples, explicit bin edges when provided,
+    or a fixed linspace when mpi.fix_disparity."""
+    S = cfg.num_bins_coarse
+    has_list = len(cfg.disparity_list) == S + 1
+    if cfg.fix_disparity:
+        if has_list:
+            d = jnp.asarray(cfg.disparity_list[1:], jnp.float32)
+            return jnp.broadcast_to(d[None], (batch_size, S))
+        return sampling.fixed_disparity_linspace(
+            batch_size, S, cfg.disparity_start, cfg.disparity_end)
+    if has_list:
+        return sampling.uniformly_sample_disparity_from_bins(
+            key, batch_size, np.asarray(cfg.disparity_list, np.float32))
+    return sampling.uniformly_sample_disparity_from_linspace_bins(
+        key, batch_size, S, cfg.disparity_start, cfg.disparity_end)
+
+
+class SynthesisTrainer:
+    """Owns the model + optimizer and builds the jitted step functions.
+
+    The reference's SynthesisTask god-object (synthesis_task.py:63-670) is
+    split: this class is the step compiler; the host loop (logging, eval
+    cadence, checkpointing) lives in mine_tpu.train.loop.
+    """
+
+    def __init__(self, config: Dict[str, Any],
+                 mesh=None,
+                 steps_per_epoch: int = 1000,
+                 lpips_params=None):
+        self.config = config
+        self.cfg = mpi_config_from_dict(config)
+        self.mesh = mesh
+        self.steps_per_epoch = steps_per_epoch
+
+        dtype_name = config.get("training.dtype", "bfloat16")
+        dtype = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
+        self.model = MPIPredictor(
+            num_layers=self.cfg.num_layers,
+            pos_encoding_multires=self.cfg.pos_encoding_multires,
+            use_alpha=self.cfg.use_alpha,
+            dtype=dtype)
+        self.remat = bool(config.get("training.remat", False))
+        self.tx = make_optimizer(config, steps_per_epoch)
+        self.lpips_params = lpips_params
+
+        if mesh is not None:
+            batch_s = mesh_lib.batch_sharding(mesh)
+            repl = mesh_lib.replicated(mesh)
+            self._train_step = jax.jit(self._train_step_impl,
+                                       in_shardings=(repl, batch_s),
+                                       out_shardings=(repl, repl),
+                                       donate_argnums=0)
+            self._eval_step = jax.jit(self._eval_step_impl,
+                                      in_shardings=(repl, batch_s, repl),
+                                      out_shardings=repl)
+        else:
+            self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
+            self._eval_step = jax.jit(self._eval_step_impl)
+
+    # ---------------- batch geometry ----------------
+
+    def global_batch_size(self) -> int:
+        """data.per_gpu_batch_size is per *device on the data axis* (the
+        reference's per-GPU batch, train.py:84); the jitted step sees the
+        global batch."""
+        per_device = int(self.config.get("data.per_gpu_batch_size", 2))
+        data_size = self.mesh.shape[mesh_lib.DATA_AXIS] if self.mesh else 1
+        return per_device * data_size
+
+    def local_batch_size(self) -> int:
+        """Examples each host must feed per step."""
+        assert self.global_batch_size() % jax.process_count() == 0
+        return self.global_batch_size() // jax.process_count()
+
+    def put_batch(self, np_batch):
+        """Host batch -> (possibly multi-host global) device batch."""
+        if self.mesh is None or jax.process_count() == 1:
+            return {k: jnp.asarray(v) for k, v in np_batch.items()}
+        sharding = mesh_lib.batch_sharding(self.mesh)
+        return {k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in np_batch.items()}
+
+    # ---------------- state ----------------
+
+    def init_state(self, batch_size: int, seed: Optional[int] = None) -> TrainState:
+        if seed is None:
+            seed = int(self.config.get("training.seed", 0))
+        H, W = self.cfg.img_h, self.cfg.img_w
+        img = jnp.zeros((batch_size, H, W, 3), jnp.float32)
+        disp = jnp.full((batch_size, self.cfg.num_bins_total), 0.5, jnp.float32)
+        return create_train_state(self.model, self.config, self.steps_per_epoch,
+                                  img, disp, seed=seed)
+
+    # ---------------- forward ----------------
+
+    def _apply_model(self, params, batch_stats, img, disparity, train, drop_key):
+        variables = {"params": params, "batch_stats": batch_stats}
+        apply = self.model.apply
+        if self.remat and train:
+            apply = jax.checkpoint(
+                lambda v, i, d: self.model.apply(
+                    v, i, d, train=True, mutable=["batch_stats"],
+                    rngs={"dropout": drop_key}))
+            return apply(variables, img, disparity)
+        if train:
+            return self.model.apply(variables, img, disparity, train=True,
+                                    mutable=["batch_stats"],
+                                    rngs={"dropout": drop_key})
+        return self.model.apply(variables, img, disparity, train=False), None
+
+    def _forward(self, params, batch_stats, batch, disparity, fine_key,
+                 drop_key, train: bool):
+        """Model forward incl. optional coarse-to-fine plane refinement."""
+        state = {"bs": batch_stats}
+
+        def predictor(img, disp):
+            out, mutated = self._apply_model(params, state["bs"], img, disp,
+                                             train, drop_key)
+            if mutated is not None:
+                state["bs"] = mutated["batch_stats"]
+            return out
+
+        if self.cfg.num_bins_fine > 0:
+            H, W = batch["src_img"].shape[1:3]
+            grid = geometry.cached_pixel_grid(H, W)
+            K_src_inv = geometry.inverse_intrinsics(batch["K_src"])
+            xyz_coarse = geometry.plane_xyz_src(grid, disparity, K_src_inv)
+        else:
+            xyz_coarse = None
+        mpi_list, disparity_all = rendering.predict_mpi_coarse_to_fine(
+            predictor, fine_key, batch["src_img"], xyz_coarse, disparity,
+            self.cfg.num_bins_fine, self.cfg.is_bg_depth_inf)
+        return mpi_list, disparity_all, state["bs"]
+
+    # ---------------- steps ----------------
+
+    def _train_step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        key = jax.random.fold_in(state.rng, state.step)
+        d_key, f_key, drop_key = jax.random.split(key, 3)
+        B = batch["src_img"].shape[0]
+        disparity = sample_disparity(d_key, B, self.cfg)
+
+        def loss_fn(params):
+            mpi_list, disparity_all, new_stats = self._forward(
+                params, state.batch_stats, batch, disparity, f_key, drop_key,
+                train=True)
+            total, metrics, _ = compute_losses(
+                mpi_list, disparity_all, batch, self.cfg, mesh=self.mesh)
+            return total, (metrics, new_stats)
+
+        (_, (metrics, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1,
+                               params=new_params,
+                               batch_stats=new_stats,
+                               opt_state=new_opt_state,
+                               rng=state.rng)
+        return new_state, metrics
+
+    def _eval_step_impl(self, state: TrainState, batch, eval_key):
+        """Validation step: eval-mode BN, LPIPS at scale 0 when weights are
+        available (synthesis_task.py:341-344,476-507)."""
+        d_key, f_key = jax.random.split(eval_key)
+        B = batch["src_img"].shape[0]
+        disparity = sample_disparity(d_key, B, self.cfg)
+        mpi_list, disparity_all, _ = self._forward(
+            state.params, state.batch_stats, batch, disparity, f_key, None,
+            train=False)
+        _, metrics, visuals = compute_losses(
+            mpi_list, disparity_all, batch, self.cfg, mesh=self.mesh,
+            is_val=True, lpips_params=self.lpips_params)
+        return metrics, visuals
+
+    # ---------------- public API ----------------
+
+    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        return self._train_step(state, batch)
+
+    def eval_step(self, state: TrainState, batch, eval_key):
+        return self._eval_step(state, batch, eval_key)

@@ -1,0 +1,70 @@
+"""Host-side utilities: meters, logging, visualization helpers.
+
+Replaces the host-side pieces of the reference's utils.py (AverageMeter :120,
+disparity_normalization_vis :6, logger wiring in train.py:116-131). The
+reference's device-side utils (Embedder -> models/embedder.py, inverse ->
+geometry.py closed forms, restore_model -> train/checkpoint.py) live with
+their layers.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class AverageMeter:
+    """Running average of a scalar metric (reference utils.py:120-141)."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+    def __str__(self):
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(**self.__dict__)
+
+
+def disparity_normalization_vis(disparity: np.ndarray) -> np.ndarray:
+    """Min-max normalize [B,1,H,W] disparity per image for visualization
+    (reference utils.py:6-17)."""
+    d = np.asarray(disparity)
+    dmin = d.min(axis=(1, 2, 3), keepdims=True)
+    dmax = d.max(axis=(1, 2, 3), keepdims=True)
+    return np.clip((d - dmin) / (dmax - dmin + 1e-12), 0.0, 1.0)
+
+
+def make_logger(log_file: Optional[str] = None,
+                name: str = "mine_tpu") -> logging.Logger:
+    """File + stdout logger (reference train.py:116-131)."""
+    logger = logging.getLogger(name)
+    formatter = logging.Formatter("[%(asctime)s %(filename)s] %(message)s")
+    handlers = [logging.StreamHandler(sys.stdout)]
+    if log_file:
+        handlers.append(logging.FileHandler(log_file))
+    for h in handlers:
+        h.setFormatter(formatter)
+    logger.handlers = handlers
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    return logger
+
+
+def metrics_to_float(metrics: Dict) -> Dict[str, float]:
+    return {k: float(v) for k, v in metrics.items()}

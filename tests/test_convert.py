@@ -1,0 +1,180 @@
+"""The torch->mine_tpu weight converter must emit exactly the key/shape space
+of our Flax models — verified against fabricated torch-layout state dicts
+(torchvision itself is not in this image)."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "tools")
+from convert_torch_weights import (_ref_key, convert_lpips,  # noqa: E402
+                                   convert_mine_checkpoint, convert_resnet_sd)
+
+
+class FakeTensor(np.ndarray):
+    pass
+
+
+def _t(*shape):
+    return np.random.RandomState(0).normal(size=shape).astype(np.float32)
+
+
+def fake_resnet18_sd(prefix=""):
+    """State dict with torchvision resnet18 key layout + shapes."""
+    sd = {}
+    sd[prefix + "conv1.weight"] = _t(64, 3, 7, 7)
+    for k in ("weight", "bias", "running_mean", "running_var"):
+        sd[prefix + f"bn1.{k}"] = _t(64)
+    chans = [(64, 64), (64, 128), (128, 256), (256, 512)]
+    for layer, (cin, cout) in enumerate(chans, start=1):
+        for b in range(2):
+            base = prefix + f"layer{layer}.{b}"
+            c_in = cin if b == 0 else cout
+            sd[f"{base}.conv1.weight"] = _t(cout, c_in, 3, 3)
+            sd[f"{base}.conv2.weight"] = _t(cout, cout, 3, 3)
+            for n in (1, 2):
+                for k in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{base}.bn{n}.{k}"] = _t(cout)
+            if b == 0 and (cin != cout or layer > 1):
+                sd[f"{base}.downsample.0.weight"] = _t(cout, c_in, 1, 1)
+                for k in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{base}.downsample.1.{k}"] = _t(cout)
+    return sd
+
+
+def fake_mine_decoder_sd(num_ch_enc=(64, 64, 128, 256, 512), E=21):
+    """State dict with the reference DepthDecoder layout (depth_decoder.py)."""
+    sd = {}
+    enc = [c + E for c in num_ch_enc]
+    dec = [16, 32, 64, 128, 256]
+
+    def conv(name, cin, cout, k):
+        sd[f"{name}.weight"] = _t(cout, cin, k, k)
+        sd[f"{name}.bias"] = _t(cout)
+
+    def conv_nobias(name, cin, cout, k):
+        sd[f"{name}.weight"] = _t(cout, cin, k, k)
+
+    def bn(name, c):
+        for k in ("weight", "bias", "running_mean", "running_var"):
+            sd[f"{name}.{k}"] = _t(c)
+
+    # neck (depth_decoder.py:56-61): Sequential(conv(no bias), bn, lrelu)
+    conv_nobias("conv_down1.0", num_ch_enc[-1], 512, 1)
+    bn("conv_down1.1", 512)
+    conv_nobias("conv_down2.0", 512, 256, 3)
+    bn("conv_down2.1", 256)
+    conv_nobias("conv_up1.0", 256, 256, 3)
+    bn("conv_up1.1", 256)
+    conv_nobias("conv_up2.0", 256, num_ch_enc[-1], 1)
+    bn("conv_up2.1", num_ch_enc[-1])
+
+    for i in range(4, -1, -1):
+        cin = enc[-1] if i == 4 else dec[i + 1]
+        key = f"convs.{_ref_key(('upconv', i, 0))}"
+        conv(f"{key}.conv.conv", cin, dec[i], 3)
+        bn(f"{key}.bn", dec[i])
+        cin = dec[i] + (enc[i - 1] if i > 0 else 0)
+        key = f"convs.{_ref_key(('upconv', i, 1))}"
+        conv(f"{key}.conv.conv", cin, dec[i], 3)
+        bn(f"{key}.bn", dec[i])
+    for s in range(4):
+        key = f"convs.{_ref_key(('dispconv', s))}"
+        conv(f"{key}.conv", dec[s], 4, 3)
+    return sd
+
+
+def test_ref_key_matches_reference_tuple_to_str():
+    """'-'.join(str(tuple)) joins the *characters* (depth_decoder.py:36-38)."""
+    assert _ref_key(("upconv", 4, 0)) == "-".join(str(("upconv", 4, 0)))
+    assert _ref_key(("dispconv", 2)).startswith("(-'-d-i-s-p")
+
+
+def test_convert_resnet_covers_model_params_exactly():
+    from mine_tpu.models.resnet import ResnetEncoder
+
+    out = convert_resnet_sd(fake_resnet18_sd())
+    model = ResnetEncoder(num_layers=18)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+
+    def flatten(prefix, tree, into):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                flatten(key, v, into)
+            else:
+                into[key] = v
+
+    want_params, want_stats = {}, {}
+    flatten("backbone", variables["params"], want_params)
+    flatten("backbone", variables["batch_stats"], want_stats)
+
+    got_params = {k: v for k, v in out.items() if not k.startswith("stats:")}
+    got_stats = {k[len("stats:"):]: v for k, v in out.items()
+                 if k.startswith("stats:")}
+
+    assert set(got_params) == set(want_params), (
+        set(got_params) ^ set(want_params))
+    assert set(got_stats) == set(want_stats)
+    for k in want_params:
+        assert got_params[k].shape == tuple(want_params[k].shape), k
+
+
+def test_convert_mine_checkpoint_covers_full_model():
+    from mine_tpu.models.mpi import MPIPredictor
+
+    ckpt = {"backbone": {("module.encoder." + k): v
+                         for k, v in fake_resnet18_sd().items()},
+            "decoder": {("module." + k): v
+                        for k, v in fake_mine_decoder_sd().items()}}
+    out = convert_mine_checkpoint(ckpt)
+
+    model = MPIPredictor(num_layers=18)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                           jnp.full((1, 2), 0.5), train=False)
+
+    def flatten(prefix, tree, into):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                flatten(key, v, into)
+            else:
+                into[key] = v
+
+    want = {}
+    flatten("", variables["params"], want)
+    got = {k: v for k, v in out.items() if not k.startswith("stats:")}
+    assert set(got) == set(want), sorted(set(got) ^ set(want))[:10]
+    for k in want:
+        assert got[k].shape == tuple(want[k].shape), (
+            k, got[k].shape, want[k].shape)
+
+
+def test_convert_lpips_covers_param_space():
+    from mine_tpu.losses.lpips import _VGG_PLAN
+
+    vgg_sd = {}
+    idxs = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+    cin = 3
+    i = 0
+    for feat, n_convs in _VGG_PLAN:
+        for _ in range(n_convs):
+            vgg_sd[f"features.{idxs[i]}.weight"] = _t(feat, cin, 3, 3)
+            vgg_sd[f"features.{idxs[i]}.bias"] = _t(feat)
+            cin = feat
+            i += 1
+    lin_sd = {f"lin{k}.model.1.weight": _t(1, f, 1, 1)
+              for k, (f, _) in enumerate(_VGG_PLAN)}
+    out = convert_lpips(vgg_sd, lin_sd)
+    assert len([k for k in out if k.startswith("conv")]) == 26
+    for k, (f, _) in enumerate(_VGG_PLAN):
+        assert out[f"lin{k}_w"].shape == (f,)
+    # converted params drive the metric
+    from mine_tpu.losses import lpips as lp
+    params = {k: jnp.asarray(v) for k, v in out.items()}
+    a = jnp.zeros((1, 3, 32, 32))
+    d = np.asarray(lp.lpips_distance(params, a, a))
+    np.testing.assert_allclose(d, 0.0, atol=1e-6)

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Convert torch checkpoints to mine_tpu .npz weight files.
+
+Three sources (all loaded with torch-cpu, no torchvision needed):
+  * torchvision ResNet state_dict (.pth)    -> backbone params + BN stats
+    (the ImageNet init the reference downloads on rank 0,
+    resnet_encoder.py:55; here converted offline once — this container has
+    no egress, so the file must be supplied)
+  * MINE training checkpoint (.pth with {"backbone","decoder"} state dicts,
+    synthesis_task.py:629-631)              -> full model params + stats
+  * lpips package LPIPS(net='vgg') state_dict + torchvision vgg16 features
+    state_dict                              -> lpips_vgg.npz for the eval
+    metric
+
+Output .npz keys are flattened mine_tpu param paths ('backbone/conv1/conv/
+kernel', BN running stats under 'stats:...'), loadable via
+mine_tpu.train.checkpoint.load_pretrained_params.
+
+Usage:
+  python tools/convert_torch_weights.py resnet --src resnet50.pth --out w.npz
+  python tools/convert_torch_weights.py mine --src checkpoint.pth --out w.npz
+  python tools/convert_torch_weights.py lpips --vgg vgg16.pth \
+      --lin lpips_vgg_lins.pth --out weights/lpips_vgg.npz
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _load_torch(path):
+    import torch
+    obj = torch.load(path, map_location="cpu")
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return obj
+
+
+def _np(t):
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def _strip_module(sd):
+    return {(k[len("module."):] if k.startswith("module.") else k): v
+            for k, v in sd.items()}
+
+
+# ---------------- resnet backbone ----------------
+
+def convert_resnet_sd(sd, prefix_out="backbone", prefix_in=""):
+    """torchvision ResNet state_dict -> flattened mine_tpu keys.
+
+    Layout mapping (models/resnet.py):
+      conv1.weight [O,I,kh,kw]        -> backbone/conv1/conv/kernel [kh,kw,I,O]
+      bn1.{weight,bias}               -> backbone/bn1/bn/{scale,bias}
+      bn1.running_{mean,var}          -> stats:backbone/bn1/bn/{mean,var}
+      layerL.B.convN / bnN            -> backbone/layer{L}_{B}/convN|bnN/...
+      layerL.B.downsample.0/.1        -> .../downsample_conv|downsample_bn/...
+    """
+    out = {}
+
+    def conv(src, dst):
+        w = _np(sd[prefix_in + src + ".weight"])
+        out[f"{prefix_out}/{dst}/conv/kernel"] = w.transpose(2, 3, 1, 0)
+        if prefix_in + src + ".bias" in sd:
+            out[f"{prefix_out}/{dst}/conv/bias"] = _np(sd[prefix_in + src + ".bias"])
+
+    def bn(src, dst):
+        out[f"{prefix_out}/{dst}/bn/scale"] = _np(sd[prefix_in + src + ".weight"])
+        out[f"{prefix_out}/{dst}/bn/bias"] = _np(sd[prefix_in + src + ".bias"])
+        out[f"stats:{prefix_out}/{dst}/bn/mean"] = _np(
+            sd[prefix_in + src + ".running_mean"])
+        out[f"stats:{prefix_out}/{dst}/bn/var"] = _np(
+            sd[prefix_in + src + ".running_var"])
+
+    conv("conv1", "conv1")
+    bn("bn1", "bn1")
+    for layer in (1, 2, 3, 4):
+        b = 0
+        while f"{prefix_in}layer{layer}.{b}.conv1.weight" in sd:
+            base_in = f"layer{layer}.{b}"
+            base_out = f"layer{layer}_{b}"
+            n = 1
+            while f"{prefix_in}{base_in}.conv{n}.weight" in sd:
+                conv(f"{base_in}.conv{n}", f"{base_out}/conv{n}")
+                bn(f"{base_in}.bn{n}", f"{base_out}/bn{n}")
+                n += 1
+            if f"{prefix_in}{base_in}.downsample.0.weight" in sd:
+                conv(f"{base_in}.downsample.0", f"{base_out}/downsample_conv")
+                bn(f"{base_in}.downsample.1", f"{base_out}/downsample_bn")
+            b += 1
+    return out
+
+
+# ---------------- MINE decoder ----------------
+
+def _ref_key(key_tuple):
+    """The reference's ModuleDict key: '-'.join(str(tuple)) — which joins the
+    *characters* of str(tuple) with '-' (depth_decoder.py:36-38)."""
+    return "-".join(str(key_tuple))
+
+
+def convert_mine_decoder_sd(sd, prefix_out="decoder"):
+    """MINE DepthDecoder state_dict -> flattened mine_tpu keys."""
+    out = {}
+
+    def conv(src, dst):
+        w = _np(sd[src + ".weight"])
+        out[f"{prefix_out}/{dst}/conv/kernel"] = w.transpose(2, 3, 1, 0)
+        if src + ".bias" in sd:
+            out[f"{prefix_out}/{dst}/conv/bias"] = _np(sd[src + ".bias"])
+
+    def bn(src, dst):
+        out[f"{prefix_out}/{dst}/bn/scale"] = _np(sd[src + ".weight"])
+        out[f"{prefix_out}/{dst}/bn/bias"] = _np(sd[src + ".bias"])
+        out[f"stats:{prefix_out}/{dst}/bn/mean"] = _np(sd[src + ".running_mean"])
+        out[f"stats:{prefix_out}/{dst}/bn/var"] = _np(sd[src + ".running_var"])
+
+    # receptive-field neck: Sequential(conv, bn, leaky) (depth_decoder.py:17-32)
+    for name in ("conv_down1", "conv_down2", "conv_up1", "conv_up2"):
+        conv(f"{name}.0", f"{name}/conv")
+        bn(f"{name}.1", f"{name}/bn")
+
+    # upconv blocks: ConvBlock = Conv3x3(.conv.conv) + BN(.bn)
+    for i in range(5):
+        for j in (0, 1):
+            key = f"convs.{_ref_key(('upconv', i, j))}"
+            conv(f"{key}.conv.conv", f"upconv_{i}_{j}/conv3x3")
+            bn(f"{key}.bn", f"upconv_{i}_{j}/bn")
+
+    # dispconv heads: Conv3x3(.conv)
+    for s in range(4):
+        key = f"convs.{_ref_key(('dispconv', s))}"
+        conv(f"{key}.conv", f"dispconv_{s}")
+    return out
+
+
+def convert_mine_checkpoint(ckpt):
+    """Full MINE checkpoint {'backbone','decoder'} -> flattened keys.
+
+    The backbone state_dict nests torchvision resnet under 'encoder.'
+    (resnet_encoder.py:81-83)."""
+    out = {}
+    out.update(convert_resnet_sd(_strip_module(ckpt["backbone"]),
+                                 prefix_in="encoder."))
+    out.update(convert_mine_decoder_sd(_strip_module(ckpt["decoder"])))
+    return out
+
+
+# ---------------- LPIPS ----------------
+
+_VGG_FEATURE_IDXS = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
+
+
+def convert_lpips(vgg_sd, lin_sd):
+    """torchvision vgg16 'features.N' convs + lpips 'linN.model.1' heads ->
+    mine_tpu lpips param dict (losses/lpips.py)."""
+    out = {}
+    for i, idx in enumerate(_VGG_FEATURE_IDXS):
+        w = _np(vgg_sd[f"features.{idx}.weight"])  # [O,I,3,3]
+        out[f"conv{i}_w"] = w.transpose(2, 3, 1, 0)
+        out[f"conv{i}_b"] = _np(vgg_sd[f"features.{idx}.bias"])
+    for k in range(5):
+        # lpips checkpoints store heads as 'lin{k}.model.1.weight' [1,C,1,1]
+        for cand in (f"lin{k}.model.1.weight", f"lins.{k}.model.1.weight"):
+            if cand in lin_sd:
+                out[f"lin{k}_w"] = _np(lin_sd[cand])[0, :, 0, 0]
+                break
+        else:
+            raise KeyError(f"lin{k} head not found in lpips state dict")
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("resnet")
+    p.add_argument("--src", required=True)
+    p.add_argument("--out", required=True)
+    p = sub.add_parser("mine")
+    p.add_argument("--src", required=True)
+    p.add_argument("--out", required=True)
+    p = sub.add_parser("lpips")
+    p.add_argument("--vgg", required=True)
+    p.add_argument("--lin", required=True)
+    p.add_argument("--out", required=True)
+    args = parser.parse_args(argv)
+
+    if args.cmd == "resnet":
+        out = convert_resnet_sd(_strip_module(_load_torch(args.src)))
+    elif args.cmd == "mine":
+        out = convert_mine_checkpoint(_load_torch(args.src))
+    else:
+        out = convert_lpips(_load_torch(args.vgg), _load_torch(args.lin))
+    np.savez(args.out, **out)
+    print(f"wrote {len(out)} arrays to {args.out}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

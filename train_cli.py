@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Training entry point — CLI-compatible with the reference's train.py.
+
+  python train_cli.py --config_path mine_tpu/configs/params_llff.yaml \
+      --workspace /path/ws --version v1 \
+      --extra_config '{"training.epochs": 100}'
+
+Differences from the reference launcher (reference: train.py +
+start_training.sh): single-controller JAX replaces torch.distributed.launch —
+no --local_rank, no CUDA_VISIBLE_DEVICES juggling, no NCCL rendezvous. On a
+multi-host TPU pod, set the standard JAX coordination env vars and pass
+--distributed to call jax.distributed.initialize(); the mesh then spans all
+hosts and the loop shards data by process index.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Training")
+    parser.add_argument("--config_path", default=None, type=str)
+    parser.add_argument("--workspace", type=str, required=True)
+    parser.add_argument("--version", type=str, required=True)
+    parser.add_argument("--extra_config", type=str, default="{}")
+    parser.add_argument("--distributed", action="store_true",
+                        help="call jax.distributed.initialize() (multi-host)")
+    parser.add_argument("--plane_parallel", type=int, default=None,
+                        help="override parallel.plane_parallel")
+    args = parser.parse_args()
+
+    import jax
+
+    # Some containers register accelerator plugins that force-override
+    # jax_platforms via jax.config; re-assert the user's JAX_PLATFORMS so the
+    # standard env-var contract holds.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.distributed:
+        jax.distributed.initialize()
+
+    from mine_tpu.config import CONFIG_DIR, load_config, save_config
+    from mine_tpu.data.llff import get_dataset
+    from mine_tpu.losses import lpips as lpips_mod
+    from mine_tpu.parallel.mesh import make_mesh
+    from mine_tpu.train.loop import TrainLoop
+    from mine_tpu.train.step import SynthesisTrainer
+    from mine_tpu.utils import make_logger
+
+    config_path = args.config_path or os.path.join(CONFIG_DIR,
+                                                   "params_llff.yaml")
+    config = load_config(config_path, extra_config=args.extra_config)
+    if args.plane_parallel is not None:
+        config["parallel.plane_parallel"] = args.plane_parallel
+
+    workspace = os.path.join(args.workspace, args.version)
+    is_lead = jax.process_index() == 0
+    if is_lead:
+        os.makedirs(workspace, exist_ok=True)
+        save_config(config, os.path.join(workspace, "params.yaml"))
+
+    log_file = os.path.join(workspace, "training.log") if is_lead else None
+    logger = make_logger(log_file)
+    logger.info("Training config: %s", json.dumps(
+        {k: v for k, v in config.items() if isinstance(v, (str, int, float,
+                                                           bool, list))},
+        indent=0))
+    logger.info("JAX devices: %s (process %d/%d)", jax.devices(),
+                jax.process_index(), jax.process_count())
+
+    tb_writer = None
+    if is_lead:
+        try:
+            from tensorboardX import SummaryWriter
+            tb_writer = SummaryWriter(log_dir=workspace)
+        except ImportError:
+            logger.warning("tensorboardX unavailable; scalar logging only")
+
+    # mesh: data x plane over all devices
+    plane = int(config.get("parallel.plane_parallel", 1))
+    data = int(config.get("parallel.data_parallel", -1))
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1 or plane > 1:
+        mesh = make_mesh(data=data, plane=plane)
+        logger.info("Mesh: %s", mesh)
+
+    train_ds, val_ds = get_dataset(config, logger)
+
+    lpips_params = lpips_mod.load_params(lpips_mod.default_weights_path())
+    if lpips_params is None:
+        logger.info("LPIPS weights not found (%s); lpips metric disabled",
+                    lpips_mod.default_weights_path())
+
+    # steps_per_epoch drives the LR schedule AND the loop's epoch accounting —
+    # computed once from the global batch geometry (per-device batch x data
+    # axis size), then owned by the trainer
+    from mine_tpu.parallel.mesh import DATA_AXIS
+    data_size = mesh.shape[DATA_AXIS] if mesh is not None else 1
+    global_batch = int(config["data.per_gpu_batch_size"]) * data_size
+    steps_per_epoch = max(1, len(train_ds) // global_batch)
+    trainer = SynthesisTrainer(config, mesh=mesh,
+                               steps_per_epoch=steps_per_epoch,
+                               lpips_params=lpips_params)
+
+    state = trainer.init_state(trainer.global_batch_size())
+    pretrained = config.get("model.pretrained_weights_path") or \
+        config.get("training.pretrained_checkpoint_path")
+    if pretrained and str(pretrained).endswith(".npz"):
+        from mine_tpu.train.checkpoint import load_pretrained_params
+        new_params, new_stats = load_pretrained_params(
+            pretrained, state.params, state.batch_stats, logger)
+        state = state.replace(params=new_params, batch_stats=new_stats)
+        logger.info("Loaded pretrained weights from %s", pretrained)
+
+    loop = TrainLoop(trainer, train_ds, val_ds, workspace,
+                     logger=logger, tb_writer=tb_writer)
+    loop.run(state)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ throughput at its shipped config — recorded, not guessed silently.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -39,7 +40,7 @@ def main():
     from mine_tpu.data.synthetic import make_batch
     from mine_tpu.train.step import SynthesisTrainer
 
-    import os
+    profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")  # jax.profiler trace
     config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
     config.update({
         "data.img_h": HEIGHT, "data.img_w": WIDTH,
@@ -58,11 +59,15 @@ def main():
         state, metrics = trainer.train_step(state, batch)
     jax.block_until_ready(metrics)
 
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, metrics = trainer.train_step(state, batch)
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     images_per_sec = BATCH * MEASURE_STEPS / dt
     result = {
@@ -71,6 +76,8 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / ESTIMATED_REFERENCE_IMAGES_PER_SEC, 3),
     }
+    if profile_dir:
+        result["profiled"] = True  # tracing overhead included — not a baseline
     print(json.dumps(result))
 
 

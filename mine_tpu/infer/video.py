@@ -105,10 +105,16 @@ class VideoGenerator:
                  img_hwc: np.ndarray,
                  chunk: int = 8,
                  dtype=jnp.bfloat16,
-                 seed: int = 0):
+                 seed: int = 0,
+                 backend: Optional[str] = None):
         self.cfg = mpi_config_from_dict(config)
         self.config = config
         self.chunk = chunk
+        if backend is None:
+            # fused Pallas composite on TPU-class backends, XLA elsewhere
+            backend = "pallas" if jax.default_backend() in ("tpu", "axon") \
+                else "xla"
+        self.backend = backend
         H, W = self.cfg.img_h, self.cfg.img_w
 
         img = _resize_bilinear(img_hwc, H, W)
@@ -133,12 +139,20 @@ class VideoGenerator:
         xyz_src = geometry.plane_xyz_src(grid, disparity, self.K_inv)
         rgb = mpi[:, :, 0:3]
         sigma = mpi[:, :, 3:4]
-        _, _, blend_weights, _ = rendering.render(
-            rgb, sigma, xyz_src,
-            use_alpha=self.cfg.use_alpha, is_bg_depth_inf=self.cfg.is_bg_depth_inf)
         src_nchw = jnp.transpose(self.img, (0, 3, 1, 2))
-        self.mpi_rgb = blend_weights * src_nchw[:, None] + \
-            (1.0 - blend_weights) * rgb
+        if self.backend == "pallas" and not self.cfg.use_alpha:
+            # one fused pass: composite + src rgb blending + blended volume
+            from mine_tpu.kernels.composite import fused_src_render_blend
+            _, _, self.mpi_rgb = fused_src_render_blend(
+                rgb, sigma, xyz_src, src_nchw,
+                is_bg_depth_inf=self.cfg.is_bg_depth_inf)
+        else:
+            _, _, blend_weights, _ = rendering.render(
+                rgb, sigma, xyz_src,
+                use_alpha=self.cfg.use_alpha,
+                is_bg_depth_inf=self.cfg.is_bg_depth_inf)
+            self.mpi_rgb = blend_weights * src_nchw[:, None] + \
+                (1.0 - blend_weights) * rgb
         self.mpi_sigma = sigma
         self._xyz_src = xyz_src
 
@@ -157,7 +171,8 @@ class VideoGenerator:
             tile(self.disparity), xyz_tgt, G_tgt_src_F44,
             tile(self.K_inv), tile(self.K),
             use_alpha=self.cfg.use_alpha,
-            is_bg_depth_inf=self.cfg.is_bg_depth_inf)
+            is_bg_depth_inf=self.cfg.is_bg_depth_inf,
+            backend=self.backend)
         return res.rgb, 1.0 / res.depth
 
     def render_poses(self, poses_F44: np.ndarray):

@@ -1,0 +1,2 @@
+from mine_tpu.kernels.composite import (fused_src_render_blend,  # noqa: F401
+                                        fused_volume_render)

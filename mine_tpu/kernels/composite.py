@@ -1,0 +1,200 @@
+"""Pallas TPU kernels: fused MPI volume compositing.
+
+The compositing math (operations/mpi_rendering.py:42-82 in the reference) is
+HBM-bound: XLA materializes per-plane intermediates (plane distances,
+transparency, the exclusive cumprod, weights, weighted rgb/depth) as
+[B,S,1,H,W] HBM tensors. These kernels stream the plane volume through VMEM
+once per spatial tile, carrying the accumulated transparency and the three
+output accumulators in registers/VMEM — one HBM read per input element, one
+write per output element, nothing else.
+
+Two kernels:
+  * fused_volume_render: target-view composite (optionally zeroing density
+    behind the camera, mpi_rendering.py:233-235) -> (rgb, depth)
+  * fused_src_render_blend: source-view composite FUSED with the reference's
+    src rgb blending + re-composite (synthesis_task.py:260-275, two full
+    passes upstream) -> (rgb, depth, blended rgb volume) in a single pass
+
+Both are forward-only (inference/eval); training uses the XLA path, which
+autodiffs. Numerical equivalence with the XLA path is test-gated
+(tests/test_kernels.py), and `interpret=True` runs them on CPU.
+
+Layout: [B, S, C, H, W] with W on the 128-lane axis and H on sublanes; the
+grid walks (batch, H-tiles) and the plane loop is statically unrolled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_tile_h(H: int, W: int, S: int) -> int:
+    """Largest H-tile (multiple of 8 or == H) keeping the block under ~4MB."""
+    budget = 4 * 1024 * 1024
+    per_row = S * 7 * W * 4  # rgb+sigma+xyz rows of one spatial row
+    th = max(1, budget // max(per_row, 1))
+    th = min(th, H)
+    if th >= 8:
+        th = (th // 8) * 8
+    while H % th != 0:
+        th -= 1
+    return max(th, 1)
+
+
+def _tgt_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
+                rgb_ref, sigma_ref, xyz_ref, rgb_out, depth_out):
+    TH, W = rgb_ref.shape[3], rgb_ref.shape[4]
+    t_acc = jnp.ones((TH, W), jnp.float32)
+    acc_rgb = jnp.zeros((3, TH, W), jnp.float32)
+    acc_d = jnp.zeros((TH, W), jnp.float32)
+    acc_w = jnp.zeros((TH, W), jnp.float32)
+
+    for s in range(S):
+        xyz_s = xyz_ref[0, s]          # [3, TH, W]
+        if s < S - 1:
+            diff = xyz_ref[0, s + 1] - xyz_s
+            dist = jnp.sqrt(jnp.sum(diff * diff, axis=0))
+        else:
+            dist = jnp.full((TH, W), 1e3, jnp.float32)
+        sig = sigma_ref[0, s, 0]
+        if z_mask:
+            sig = jnp.where(xyz_s[2] >= 0.0, sig, 0.0)
+        trans = jnp.exp(-sig * dist)
+        w = t_acc * (1.0 - trans)
+        acc_rgb = acc_rgb + w[None] * rgb_ref[0, s]
+        acc_d = acc_d + w * xyz_s[2]
+        acc_w = acc_w + w
+        t_acc = t_acc * (trans + 1e-6)
+
+    rgb_out[0] = acc_rgb
+    if is_bg_depth_inf:
+        depth_out[0, 0] = acc_d + (1.0 - acc_w) * 1000.0
+    else:
+        depth_out[0, 0] = acc_d / (acc_w + 1e-5)
+
+
+@functools.partial(jax.jit, static_argnames=("z_mask", "is_bg_depth_inf",
+                                             "interpret"))
+def fused_volume_render(rgb_BS3HW: jnp.ndarray,
+                        sigma_BS1HW: jnp.ndarray,
+                        xyz_BS3HW: jnp.ndarray,
+                        z_mask: bool = False,
+                        is_bg_depth_inf: bool = False,
+                        interpret: bool = False
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused equivalent of rendering.plane_volume_rendering (+ optional
+    behind-camera masking) returning (rgb [B,3,H,W], depth [B,1,H,W])."""
+    B, S, _, H, W = rgb_BS3HW.shape
+    TH = _pick_tile_h(H, W, S)
+    grid = (B, H // TH)
+
+    def vol_spec(C):
+        return pl.BlockSpec((1, S, C, TH, W),
+                            lambda b, h: (b, 0, 0, h, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_tgt_kernel, S, z_mask, is_bg_depth_inf),
+        grid=grid,
+        in_specs=[vol_spec(3), vol_spec(1), vol_spec(3)],
+        out_specs=[
+            pl.BlockSpec((1, 3, TH, W), lambda b, h: (b, 0, h, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, TH, W), lambda b, h: (b, 0, h, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 3, H, W), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, H, W), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rgb_BS3HW.astype(jnp.float32), sigma_BS1HW.astype(jnp.float32),
+      xyz_BS3HW.astype(jnp.float32))
+
+
+def _src_blend_kernel(S: int, is_bg_depth_inf: bool,
+                      rgb_ref, sigma_ref, xyz_ref, src_ref,
+                      rgb_out, depth_out, blended_out):
+    TH, W = rgb_ref.shape[3], rgb_ref.shape[4]
+    src = src_ref[0]  # [3, TH, W]
+    t_acc = jnp.ones((TH, W), jnp.float32)
+    acc_rgb = jnp.zeros((3, TH, W), jnp.float32)
+    acc_d = jnp.zeros((TH, W), jnp.float32)
+    acc_w = jnp.zeros((TH, W), jnp.float32)
+
+    for s in range(S):
+        xyz_s = xyz_ref[0, s]
+        if s < S - 1:
+            diff = xyz_ref[0, s + 1] - xyz_s
+            dist = jnp.sqrt(jnp.sum(diff * diff, axis=0))
+        else:
+            dist = jnp.full((TH, W), 1e3, jnp.float32)
+        sig = sigma_ref[0, s, 0]
+        trans = jnp.exp(-sig * dist)
+        w = t_acc * (1.0 - trans)
+        # blend_weights for plane s is the exclusive accumulated transparency
+        # (synthesis_task.py:267-268): planes visible from the camera copy the
+        # real source pixels
+        blended = t_acc[None] * src + (1.0 - t_acc[None]) * rgb_ref[0, s]
+        blended_out[0, s] = blended
+        acc_rgb = acc_rgb + w[None] * blended
+        acc_d = acc_d + w * xyz_s[2]
+        acc_w = acc_w + w
+        t_acc = t_acc * (trans + 1e-6)
+
+    rgb_out[0] = acc_rgb
+    if is_bg_depth_inf:
+        depth_out[0, 0] = acc_d + (1.0 - acc_w) * 1000.0
+    else:
+        depth_out[0, 0] = acc_d / (acc_w + 1e-5)
+
+
+@functools.partial(jax.jit, static_argnames=("is_bg_depth_inf", "interpret"))
+def fused_src_render_blend(rgb_BS3HW: jnp.ndarray,
+                           sigma_BS1HW: jnp.ndarray,
+                           xyz_BS3HW: jnp.ndarray,
+                           src_img_B3HW: jnp.ndarray,
+                           is_bg_depth_inf: bool = False,
+                           interpret: bool = False):
+    """Source-view composite + rgb blending + re-composite in one pass.
+
+    Equivalent to rendering.render + the blending block of the reference
+    (synthesis_task.py:260-275). Returns (rgb [B,3,H,W], depth [B,1,H,W],
+    blended mpi rgb [B,S,3,H,W] — the volume the novel-view warp consumes).
+    """
+    B, S, _, H, W = rgb_BS3HW.shape
+    TH = _pick_tile_h(H, W, S)
+    grid = (B, H // TH)
+
+    def vol_spec(C):
+        return pl.BlockSpec((1, S, C, TH, W),
+                            lambda b, h: (b, 0, 0, h, 0),
+                            memory_space=pltpu.VMEM)
+
+    img_spec = pl.BlockSpec((1, 3, TH, W), lambda b, h: (b, 0, h, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_src_blend_kernel, S, is_bg_depth_inf),
+        grid=grid,
+        in_specs=[vol_spec(3), vol_spec(1), vol_spec(3), img_spec],
+        out_specs=[
+            img_spec,
+            pl.BlockSpec((1, 1, TH, W), lambda b, h: (b, 0, h, 0),
+                         memory_space=pltpu.VMEM),
+            vol_spec(3),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, 3, H, W), jnp.float32),
+            jax.ShapeDtypeStruct((B, 1, H, W), jnp.float32),
+            jax.ShapeDtypeStruct((B, S, 3, H, W), jnp.float32),
+        ],
+        interpret=interpret,
+    )(rgb_BS3HW.astype(jnp.float32), sigma_BS1HW.astype(jnp.float32),
+      xyz_BS3HW.astype(jnp.float32), src_img_B3HW.astype(jnp.float32))

@@ -124,7 +124,8 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          K_src_inv: jnp.ndarray,
                          K_tgt: jnp.ndarray,
                          use_alpha: bool = False,
-                         is_bg_depth_inf: bool = False) -> TgtRender:
+                         is_bg_depth_inf: bool = False,
+                         backend: str = "xla") -> TgtRender:
     """Render the MPI into a target camera.
 
     Concatenates [rgb, sigma, xyz_tgt] into a 7-channel plane volume, warps all
@@ -162,12 +163,19 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
     tgt_sigma = warped[:, :, 3:4]
     tgt_xyz = warped[:, :, 4:7]
 
-    tgt_z = tgt_xyz[:, :, 2:3]
-    tgt_sigma = jnp.where(tgt_z >= 0.0, tgt_sigma, 0.0)
-
-    rgb_syn, depth_syn, _, _ = render(tgt_rgb, tgt_sigma, tgt_xyz,
-                                      use_alpha=use_alpha,
-                                      is_bg_depth_inf=is_bg_depth_inf)
+    if backend == "pallas" and not use_alpha:
+        # fused forward-only composite (inference/eval): z-masking + volume
+        # rendering in one HBM pass (mine_tpu.kernels.composite)
+        from mine_tpu.kernels.composite import fused_volume_render
+        rgb_syn, depth_syn = fused_volume_render(
+            tgt_rgb, tgt_sigma, tgt_xyz, z_mask=True,
+            is_bg_depth_inf=is_bg_depth_inf)
+    else:
+        tgt_z = tgt_xyz[:, :, 2:3]
+        tgt_sigma = jnp.where(tgt_z >= 0.0, tgt_sigma, 0.0)
+        rgb_syn, depth_syn, _, _ = render(tgt_rgb, tgt_sigma, tgt_xyz,
+                                          use_alpha=use_alpha,
+                                          is_bg_depth_inf=is_bg_depth_inf)
     mask = jnp.sum(valid.reshape(B, S, H, W).astype(jnp.float32),
                    axis=1, keepdims=True)  # [B,1,H,W]
     return TgtRender(rgb=rgb_syn, depth=depth_syn, mask=mask)

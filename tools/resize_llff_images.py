@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Offline LLFF image pre-downsampling.
+
+Writes `images_{ratio}/` copies of each scene's `images/` directory, resized
+by 1/ratio (the reference's input_pipelines/llff/misc/resize_nerf_llff_images.py
+with ratio 7.875: 4032x3024 -> 512x384). The training dataset then reads the
+pre-downsampled folder (data.img_pre_downsample_ratio).
+
+Usage:
+  python tools/resize_llff_images.py --root /data/nerf_llff_data --ratio 7.875
+"""
+
+import argparse
+import os
+
+from PIL import Image
+
+
+def resize_scene(scene_dir: str, ratio: float) -> int:
+    src_dir = os.path.join(scene_dir, "images")
+    if not os.path.isdir(src_dir):
+        return 0
+    dst_dir = os.path.join(scene_dir, f"images_{ratio}")
+    os.makedirs(dst_dir, exist_ok=True)
+    n = 0
+    for name in sorted(os.listdir(src_dir)):
+        src = os.path.join(src_dir, name)
+        try:
+            img = Image.open(src)
+        except Exception:
+            continue
+        w, h = img.size
+        img = img.resize((round(w / ratio), round(h / ratio)), Image.BICUBIC)
+        img.save(os.path.join(dst_dir, name))
+        n += 1
+    return n
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--root", required=True,
+                        help="dataset root containing scene directories")
+    parser.add_argument("--ratio", type=float, default=7.875)
+    args = parser.parse_args()
+
+    total = 0
+    for scene in sorted(os.listdir(args.root)):
+        scene_dir = os.path.join(args.root, scene)
+        if os.path.isdir(scene_dir):
+            n = resize_scene(scene_dir, args.ratio)
+            print(f"{scene}: {n} images")
+            total += n
+    print(f"done: {total} images")
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,10 @@ def path_planning(num_frames: int, x: float, y: float, z: float,
     return xs, ys, zs
 
 
+# band height of the Pallas warp gather (kernels/warp.py); poses whose
+# row-block span exceeds it fall back to the XLA gather
+WARP_BAND = 16
+
 TRAJECTORY_PRESETS = {
     # dataset -> (fps, num_frames, x_ranges, y_ranges, z_ranges, types, names)
     # (reference image_to_video.py:156-175)
@@ -156,9 +160,10 @@ class VideoGenerator:
         self.mpi_sigma = sigma
         self._xyz_src = xyz_src
 
-        self._render_chunk = jax.jit(self._render_chunk_impl)
+        self._render_chunk = jax.jit(self._render_chunk_impl,
+                                     static_argnames=("warp_impl",))
 
-    def _render_chunk_impl(self, G_tgt_src_F44):
+    def _render_chunk_impl(self, G_tgt_src_F44, warp_impl: str):
         """Render F poses at once: the pose axis is the batch axis."""
         F = G_tgt_src_F44.shape[0]
 
@@ -172,11 +177,56 @@ class VideoGenerator:
             tile(self.K_inv), tile(self.K),
             use_alpha=self.cfg.use_alpha,
             is_bg_depth_inf=self.cfg.is_bg_depth_inf,
-            backend=self.backend)
+            backend=self.backend,
+            warp_impl=warp_impl,
+            warp_band=WARP_BAND)
         return res.rgb, 1.0 / res.depth
+
+    def _max_row_block_span(self, poses_F44: np.ndarray,
+                            rows_per_block: int = 8, step: int = 8) -> float:
+        """Host-side (numpy) upper estimate of the per-row-block source-row
+        span of the warp, over all poses and planes — decides whether the
+        banded Pallas gather's correctness domain holds for a trajectory
+        (kernels/warp.py module docstring)."""
+        H, W = self.cfg.img_h, self.cfg.img_w
+        F = poses_F44.shape[0]
+        depths = 1.0 / np.asarray(self.disparity[0])  # [S]
+        S = depths.shape[0]
+
+        # one source of truth: the same homography composition the device
+        # warp uses (geometry.homography_tgt_src), batched over [F,S]
+        G = jnp.broadcast_to(jnp.asarray(poses_F44)[:, None], (F, S, 4, 4))
+        d = jnp.broadcast_to(jnp.asarray(depths)[None, :], (F, S))
+        Hts = geometry.homography_tgt_src(
+            jnp.broadcast_to(self.K[0], (F, S, 3, 3)),
+            jnp.broadcast_to(self.K_inv[0], (F, S, 3, 3)),
+            G, d)
+        Hst = np.asarray(geometry.inverse_3x3(Hts))          # [F,S,3,3]
+
+        # block-boundary rows x coarse columns
+        rows = np.stack([np.arange(0, H, rows_per_block),
+                         np.arange(0, H, rows_per_block) + rows_per_block - 1],
+                        axis=1).reshape(-1).astype(np.float32)  # [2*NB]
+        cols = np.arange(0, W, step, dtype=np.float32)
+        ii, jj = np.meshgrid(rows, cols, indexing="ij")      # [NR,NJ]
+        pts = np.stack([jj, ii, np.ones_like(ii)], axis=0)   # [3,NR,NJ]
+
+        num = np.einsum("fsab,brj->fsarj", Hst, pts)         # [F,S,3,NR,NJ]
+        y = num[:, :, 1] / num[:, :, 2]                      # [F,S,NR,NJ]
+        y = np.clip(y, 0.0, H - 1.0)
+        yb = y.reshape(y.shape[0], y.shape[1], -1, 2, y.shape[-1])  # per block
+        span = yb.max(axis=(3, 4)) - yb.min(axis=(3, 4))
+        return float(span.max())
 
     def render_poses(self, poses_F44: np.ndarray):
         """[F,4,4] -> (rgb [F,3,H,W], disparity [F,1,H,W]) numpy."""
+        warp_impl = "xla"
+        if self.backend == "pallas" and self.cfg.img_h % 8 == 0:
+            # banded Pallas gather only when the trajectory's warp fits the
+            # band (margin of 2 for the coarse span estimate)
+            span = self._max_row_block_span(poses_F44)
+            if span + 4 <= WARP_BAND:
+                warp_impl = "pallas"
         F = poses_F44.shape[0]
         rgbs, disps = [], []
         for i in range(0, F, self.chunk):
@@ -187,7 +237,7 @@ class VideoGenerator:
                 chunk = np.concatenate(
                     [chunk, np.tile(np.eye(4, dtype=np.float32),
                                     (pad, 1, 1))], axis=0)
-            rgb, disp = self._render_chunk(jnp.asarray(chunk))
+            rgb, disp = self._render_chunk(jnp.asarray(chunk), warp_impl)
             rgb, disp = np.asarray(rgb), np.asarray(disp)
             if pad:
                 rgb, disp = rgb[:-pad], disp[:-pad]

@@ -125,7 +125,9 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          K_tgt: jnp.ndarray,
                          use_alpha: bool = False,
                          is_bg_depth_inf: bool = False,
-                         backend: str = "xla") -> TgtRender:
+                         backend: str = "xla",
+                         warp_impl: str = "xla",
+                         warp_band: int = 16) -> TgtRender:
     """Render the MPI into a target camera.
 
     Concatenates [rgb, sigma, xyz_tgt] into a 7-channel plane volume, warps all
@@ -156,6 +158,8 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         expand(K_src_inv),
         expand(K_tgt),
         grid,
+        impl=warp_impl,
+        band=warp_band,
     )
 
     warped = warped.reshape(B, S, 7, H, W)

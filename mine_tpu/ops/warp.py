@@ -75,7 +75,9 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     G_tgt_src: jnp.ndarray,
                     K_src_inv: jnp.ndarray,
                     K_tgt: jnp.ndarray,
-                    meshgrid_tgt: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    meshgrid_tgt: jnp.ndarray,
+                    impl: str = "xla",
+                    band: int = 16) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Warp source-plane images into the target camera via inverse homography.
 
     For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
@@ -92,6 +94,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
       G_tgt_src: [B', 4, 4]
       K_src_inv, K_tgt: [B', 3, 3]
       meshgrid_tgt: [3, Ht, Wt] homogeneous target pixel grid
+      impl: "xla" (gather; autodiffed) or "pallas" (banded MXU gather kernel,
+        forward-only; caller must validate the band via kernels.warp.band_span)
     Returns:
       tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
     """
@@ -109,5 +113,9 @@ def homography_warp(src_BCHW: jnp.ndarray,
 
     valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
 
-    tgt = bilinear_sample(src_BCHW, x, y)
+    if impl == "pallas":
+        from mine_tpu.kernels.warp import pallas_bilinear_sample
+        tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band)
+    else:
+        tgt = bilinear_sample(src_BCHW, x, y)
     return tgt, valid

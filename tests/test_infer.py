@@ -64,3 +64,8 @@ def test_video_generator_end_to_end(tmp_path):
     assert np.all(np.isfinite(rgb))
     # identity pose reproduces the blended source composite closely
     assert np.abs(rgb[0] - rgb[0].clip(0, 1)).max() < 1e-5
+
+    # near-identity trajectories sit inside the Pallas warp band: the span
+    # is the row-block's own 8-row extent (7) + small translation slope
+    span = gen._max_row_block_span(poses)
+    assert 7.0 <= span <= 9.0, span

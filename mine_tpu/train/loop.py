@@ -11,8 +11,10 @@ checkpoint), same meters and tensorboard tags, but:
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +28,54 @@ from mine_tpu.utils import AverageMeter, disparity_normalization_vis, metrics_to
 TRAIN_METER_KEYS = ("loss", "loss_rgb_src", "loss_ssim_src",
                     "loss_disp_pt3dsrc", "loss_rgb_tgt", "loss_ssim_tgt",
                     "lpips_tgt", "psnr_tgt", "loss_disp_pt3dtgt")
+
+
+def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
+    """Background-thread prefetch: overlaps host batch assembly/H2D staging
+    with the device step. The reference loads synchronously on the training
+    thread (num_workers=0, train.py:84-87 — flagged in SURVEY.md section 7
+    'known quirks' as worth overlapping).
+
+    Abandoning the generator (consumer raised / broke out) stops the producer
+    promptly instead of leaving a thread blocked on a full queue holding
+    batch memory.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+    err = []
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterator:
+                if not _put(item):
+                    return
+        except BaseException as e:  # surface loader errors on the consumer
+            err.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 class TrainLoop:
@@ -97,7 +147,7 @@ class TrainLoop:
 
         step_in_epoch = 0
         t_last = time.perf_counter()
-        for np_batch in it:
+        for np_batch in prefetch(it):
             batch = self.trainer.put_batch(np_batch)
             state, metrics = self.trainer.train_step(state, batch)
             step_in_epoch += 1

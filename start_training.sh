@@ -1,0 +1,59 @@
+#!/bin/sh
+# Launch wrapper with KEY=VALUE arguments — CLI parity with the reference's
+# start_training.sh (which parsed KEY=VALUE pairs, picked the per-dataset
+# YAML, and exec'd torch.distributed.launch). Single-controller JAX needs no
+# per-rank launcher; multi-host pods pass DISTRIBUTED=1 and the standard JAX
+# coordination env vars. POSIX sh (runs under dash).
+#
+# Usage:
+#   sh start_training.sh DATASET=llff WORKSPACE=/path/ws VERSION=v1 \
+#       EXTRA_CONFIG='{"data.training_set_path": "/data/nerf_llff_data"}' \
+#       [DISTRIBUTED=1] [PLANE_PARALLEL=2]
+set -eu
+
+DATASET=llff
+WORKSPACE=""
+VERSION=""
+EXTRA_CONFIG='{}'
+DISTRIBUTED=0
+PLANE_PARALLEL=""
+
+for arg in "$@"; do
+  case "$arg" in
+    DATASET=*)        DATASET="${arg#*=}" ;;
+    WORKSPACE=*)      WORKSPACE="${arg#*=}" ;;
+    VERSION=*)        VERSION="${arg#*=}" ;;
+    EXTRA_CONFIG=*)   EXTRA_CONFIG="${arg#*=}" ;;
+    DISTRIBUTED=*)    DISTRIBUTED="${arg#*=}" ;;
+    PLANE_PARALLEL=*) PLANE_PARALLEL="${arg#*=}" ;;
+    *) echo "unknown argument: $arg (expected KEY=VALUE)" >&2; exit 2 ;;
+  esac
+done
+
+if [ -z "$WORKSPACE" ] || [ -z "$VERSION" ]; then
+  echo "WORKSPACE=... and VERSION=... are required" >&2
+  exit 2
+fi
+
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+# canonical data.name values map onto their config files; like the reference
+# launcher, unmatched indoor datasets fall back to the realestate config
+case "$DATASET" in
+  realestate10k|nyu|ibims) CONFIG_NAME=realestate ;;
+  kitti) CONFIG_NAME=kitti_raw ;;
+  *) CONFIG_NAME="$DATASET" ;;
+esac
+CONFIG_PATH="$SCRIPT_DIR/mine_tpu/configs/params_${CONFIG_NAME}.yaml"
+if [ ! -f "$CONFIG_PATH" ]; then
+  echo "no config for dataset '$DATASET' ($CONFIG_PATH)" >&2
+  exit 2
+fi
+
+set -- --config_path "$CONFIG_PATH" \
+       --workspace "$WORKSPACE" \
+       --version "$VERSION" \
+       --extra_config "$EXTRA_CONFIG"
+[ "$DISTRIBUTED" = "1" ] && set -- "$@" --distributed
+[ -n "$PLANE_PARALLEL" ] && set -- "$@" --plane_parallel "$PLANE_PARALLEL"
+
+exec python "$SCRIPT_DIR/train_cli.py" "$@"

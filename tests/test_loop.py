@@ -1,0 +1,81 @@
+import time
+
+import numpy as np
+import pytest
+
+from mine_tpu.train.loop import prefetch
+from mine_tpu.utils import AverageMeter, disparity_normalization_vis
+
+
+def test_prefetch_preserves_order_and_values():
+    items = [{"a": np.full((2, 2), i)} for i in range(7)]
+    out = list(prefetch(iter(items), depth=3))
+    assert len(out) == 7
+    for i, item in enumerate(out):
+        np.testing.assert_array_equal(item["a"], np.full((2, 2), i))
+
+
+def test_prefetch_overlaps_producer_time():
+    """Scheduling-independent overlap check: with queue depth 2, the producer
+    finishes before the consumer drains the last item."""
+    done = []
+
+    def gen():
+        for i in range(4):
+            time.sleep(0.01)
+            yield i
+        done.append(True)
+
+    seen = []
+    for i in prefetch(gen(), depth=2):
+        time.sleep(0.05)  # slow consumer lets the producer run ahead
+        seen.append((i, bool(done)))
+    assert [i for i, _ in seen] == [0, 1, 2, 3]
+    assert seen[-1][1], "producer should have finished ahead of the consumer"
+
+
+def test_prefetch_abandoned_consumer_stops_producer():
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    it = prefetch(iter(gen()), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon the generator
+    time.sleep(0.3)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n, "producer kept running after abandonment"
+    assert n < 10
+
+
+def test_prefetch_propagates_errors():
+    def bad_gen():
+        yield 1
+        raise ValueError("loader broke")
+
+    it = prefetch(bad_gen())
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="loader broke"):
+        list(it)
+
+
+def test_average_meter():
+    m = AverageMeter("x", ":.2f")
+    m.update(1.0, n=2)
+    m.update(4.0, n=1)
+    assert m.count == 3
+    np.testing.assert_allclose(m.avg, 2.0)
+    assert "x 4.00 (2.00)" in str(m)
+
+
+def test_disparity_normalization_vis():
+    d = np.stack([np.linspace(0.2, 0.8, 16).reshape(1, 4, 4),
+                  np.full((1, 4, 4), 0.5)])
+    v = disparity_normalization_vis(d)
+    np.testing.assert_allclose(v[0].min(), 0.0, atol=1e-6)
+    np.testing.assert_allclose(v[0].max(), 1.0, atol=1e-6)
+    assert np.all(np.isfinite(v[1]))  # constant map: eps guard, no NaN

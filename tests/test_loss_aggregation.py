@@ -1,0 +1,65 @@
+"""The cross-scale aggregation formula must match synthesis_task.loss_fcn
+(:394-400): full term set at scale 0; rgb+ssim per extra scale only when
+use_multi_scale; disparity and v2-smoothness terms at every extra scale."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import mine_tpu.train.loss as loss_mod
+from mine_tpu.config import MPIConfig
+
+
+def _fake_scales(monkeypatch, values):
+    """Patch loss_per_scale to return synthetic per-scale dicts."""
+    def fake(scale, mpi, disparity, batch, G, cfg, scale_factor, **kw):
+        v = values[scale]
+        d = {k: jnp.asarray(val, jnp.float32) for k, val in v.items()}
+        return d, {"vis": scale}, jnp.ones((1,))
+
+    monkeypatch.setattr(loss_mod, "loss_per_scale", fake)
+
+
+def test_aggregation_multi_scale(monkeypatch):
+    values = {
+        s: {"loss": 10.0 + s, "loss_rgb_tgt": 1.0 * (s + 1),
+            "loss_ssim_tgt": 0.1 * (s + 1),
+            "loss_disp_pt3dsrc": 0.01 * (s + 1),
+            "loss_disp_pt3dtgt": 0.001 * (s + 1),
+            "loss_smooth_src_v2": 0.2 * (s + 1),
+            "loss_smooth_tgt_v2": 0.02 * (s + 1)}
+        for s in range(4)
+    }
+    _fake_scales(monkeypatch, values)
+    cfg = MPIConfig(use_multi_scale=True)
+    total, metrics, vis = loss_mod.compute_losses(
+        [None] * 4, jnp.ones((1, 4)),
+        {"G_src_tgt": jnp.eye(4)[None]}, cfg)
+
+    expect = values[0]["loss"]
+    for s in (1, 2, 3):
+        v = values[s]
+        expect += v["loss_rgb_tgt"] + v["loss_ssim_tgt"]
+        expect += v["loss_disp_pt3dsrc"] + v["loss_disp_pt3dtgt"]
+        expect += v["loss_smooth_src_v2"] + v["loss_smooth_tgt_v2"]
+    np.testing.assert_allclose(float(total), expect, rtol=1e-6)
+    assert vis == {"vis": 0}  # scale-0 visuals
+    np.testing.assert_allclose(float(metrics["loss"]), expect, rtol=1e-6)
+    # other metric entries are scale-0 values
+    np.testing.assert_allclose(float(metrics["loss_rgb_tgt"]), 1.0)
+
+
+def test_aggregation_single_scale(monkeypatch):
+    values = {
+        s: {"loss": 5.0, "loss_rgb_tgt": 1.0, "loss_ssim_tgt": 1.0,
+            "loss_disp_pt3dsrc": 0.5, "loss_disp_pt3dtgt": 0.25,
+            "loss_smooth_src_v2": 0.125, "loss_smooth_tgt_v2": 0.0625}
+        for s in range(4)
+    }
+    _fake_scales(monkeypatch, values)
+    cfg = MPIConfig(use_multi_scale=False)
+    total, _, _ = loss_mod.compute_losses(
+        [None] * 4, jnp.ones((1, 4)),
+        {"G_src_tgt": jnp.eye(4)[None]}, cfg)
+    # no rgb/ssim from scales 1-3; disparity + v2 smoothness still included
+    expect = 5.0 + 3 * (0.5 + 0.25 + 0.125 + 0.0625)
+    np.testing.assert_allclose(float(total), expect, rtol=1e-6)

@@ -9,6 +9,40 @@ import mine_tpu.train.loss as loss_mod
 from mine_tpu.config import MPIConfig
 
 
+def test_compute_scale_factor_formula():
+    """exp(mean(log(syn) - log(gt))) per batch element
+    (synthesis_task.py:211-220): a uniform 2x disparity offset -> factor 2."""
+    syn = jnp.full((2, 1, 8), 0.5)
+    gt = jnp.full((2, 1, 8), 0.25)
+    sf = loss_mod.compute_scale_factor(syn, gt)
+    np.testing.assert_allclose(np.asarray(sf), 2.0, rtol=1e-6)
+    # geometric mean over points
+    syn2 = jnp.asarray([[[1.0, 4.0]]])
+    gt2 = jnp.asarray([[[1.0, 1.0]]])
+    np.testing.assert_allclose(float(loss_mod.compute_scale_factor(syn2, gt2)[0]),
+                               2.0, rtol=1e-6)
+
+
+def test_disp_loss_formula():
+    """disp loss = mean|log(syn/sf) - log(gt)| (synthesis_task.py:310-312)."""
+    syn = jnp.asarray([[[2.0, 2.0]]])
+    gt = jnp.asarray([[[1.0, 1.0]]])
+    sf = jnp.asarray([2.0])
+    np.testing.assert_allclose(
+        float(loss_mod._disp_loss(syn, gt, sf)), 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        float(loss_mod._disp_loss(syn, gt, jnp.asarray([1.0]))),
+        np.log(2.0), rtol=1e-6)
+
+
+def test_project_points():
+    K = jnp.asarray([[[10.0, 0, 5.0], [0, 10.0, 4.0], [0, 0, 1]]])
+    pt = jnp.asarray([[[1.0], [2.0], [4.0]]])  # camera xyz
+    pxpy = np.asarray(loss_mod._project_points(K, pt))
+    np.testing.assert_allclose(pxpy[0, :, 0], [10 * 1 / 4 + 5, 10 * 2 / 4 + 4],
+                               rtol=1e-6)
+
+
 def _fake_scales(monkeypatch, values):
     """Patch loss_per_scale to return synthetic per-scale dicts."""
     def fake(scale, mpi, disparity, batch, G, cfg, scale_factor, **kw):

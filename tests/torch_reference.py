@@ -1,0 +1,241 @@
+"""Independent torch implementation of the MINE network for cross-checking.
+
+Test asset only. Written clean-room from the documented reference semantics
+(SURVEY.md section 2: resnet_encoder.py / depth_decoder.py /
+monodepth2 layers) with torchvision-compatible parameter names so
+tools/convert_torch_weights.py converts its state dicts. Running this next to
+the Flax models with converted weights validates the WHOLE port numerically:
+padding, BN statistics, the receptive-field neck, skip wiring, positional
+embedding order, and the output heads.
+"""
+
+import math
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+# ---------------- ResNet-18 (torchvision layout) ----------------
+
+class BasicBlock(nn.Module):
+    def __init__(self, inplanes, planes, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.downsample = None
+        if stride != 1 or inplanes != planes:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(inplanes, planes, 1, stride, bias=False),
+                nn.BatchNorm2d(planes))
+
+    def forward(self, x):
+        res = x if self.downsample is None else self.downsample(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return F.relu(y + res)
+
+
+class Bottleneck(nn.Module):
+    """torchvision-style bottleneck (stride on conv2, 'ResNet v1.5')."""
+
+    def __init__(self, inplanes, planes, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.downsample = None
+        if stride != 1 or inplanes != planes * 4:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(inplanes, planes * 4, 1, stride, bias=False),
+                nn.BatchNorm2d(planes * 4))
+
+    def forward(self, x):
+        res = x if self.downsample is None else self.downsample(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return F.relu(y + res)
+
+
+class TorchResnet18Encoder(nn.Module):
+    """5-feature-map encoder with ImageNet input normalization
+    (resnet_encoder.py:88-108 semantics)."""
+
+    MEAN = (0.485, 0.456, 0.406)
+    STD = (0.229, 0.224, 0.225)
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        layers = []
+        inplanes = 64
+        for planes, stride in ((64, 1), (128, 2), (256, 2), (512, 2)):
+            blocks = [BasicBlock(inplanes, planes, stride),
+                      BasicBlock(planes, planes, 1)]
+            layers.append(nn.Sequential(*blocks))
+            inplanes = planes
+        self.layer1, self.layer2, self.layer3, self.layer4 = layers
+
+    def forward(self, img):
+        mean = torch.tensor(self.MEAN).view(1, 3, 1, 1)
+        std = torch.tensor(self.STD).view(1, 3, 1, 1)
+        x = (img - mean) / std
+        conv1_out = F.relu(self.bn1(self.conv1(x)))
+        b1 = self.layer1(self.maxpool(conv1_out))
+        b2 = self.layer2(b1)
+        b3 = self.layer3(b2)
+        b4 = self.layer4(b3)
+        return [conv1_out, b1, b2, b3, b4]
+
+class TorchResnet50Encoder(TorchResnet18Encoder):
+    """Bottleneck variant — the flagship backbone (synthesis_task.py:68)."""
+
+    def __init__(self):
+        nn.Module.__init__(self)
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        layers = []
+        inplanes = 64
+        for planes, stride, n in ((64, 1, 3), (128, 2, 4),
+                                  (256, 2, 6), (512, 2, 3)):
+            blocks = [Bottleneck(inplanes, planes, stride)]
+            blocks += [Bottleneck(planes * 4, planes, 1) for _ in range(n - 1)]
+            layers.append(nn.Sequential(*blocks))
+            inplanes = planes * 4
+        self.layer1, self.layer2, self.layer3, self.layer4 = layers
+
+
+# ---------------- positional embedder ----------------
+
+def torch_embed(x, multires=10):
+    """[B*S,1] -> [B*S, 1+2*multires]: [x, sin(2^0 x), cos(2^0 x), ...]."""
+    outs = [x]
+    for i in range(multires):
+        f = 2.0 ** i
+        outs.append(torch.sin(x * f))
+        outs.append(torch.cos(x * f))
+    return torch.cat(outs, dim=-1)
+
+
+# ---------------- decoder (depth_decoder.py semantics) ----------------
+
+def conv_bn_lrelu(cin, cout, k):
+    return nn.Sequential(
+        nn.Conv2d(cin, cout, k, 1, (k - 1) // 2, bias=False),
+        nn.BatchNorm2d(cout),
+        nn.LeakyReLU(0.1))
+
+
+class ConvBlockT(nn.Module):
+    """Reflect-pad 3x3 conv + BN + ELU (monodepth2 layers.py:106-138).
+
+    Parameter names mimic the reference's ConvBlock(.conv.conv/.bn) so the
+    converter's key mapping applies."""
+
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv = nn.Sequential()  # placeholder for naming
+        self.conv.conv = nn.Conv2d(cin, cout, 3)
+        self.bn = nn.BatchNorm2d(cout)
+
+    def forward(self, x):
+        x = F.pad(x, (1, 1, 1, 1), mode="reflect")
+        return F.elu(self.bn(self.conv.conv(x)))
+
+
+class Conv3x3T(nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, 3)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (1, 1, 1, 1), mode="reflect"))
+
+
+def _ref_key(key_tuple):
+    return "-".join(str(key_tuple))
+
+
+class TorchMPIDecoder(nn.Module):
+    def __init__(self, num_ch_enc=(64, 64, 128, 256, 512), multires=10,
+                 use_alpha=False):
+        super().__init__()
+        self.multires = multires
+        self.use_alpha = use_alpha
+        E = 1 + 2 * multires
+        enc = [c + E for c in num_ch_enc]
+        dec = [16, 32, 64, 128, 256]
+
+        self.downsample = nn.MaxPool2d(3, 2, 1)
+        self.conv_down1 = conv_bn_lrelu(num_ch_enc[-1], 512, 1)
+        self.conv_down2 = conv_bn_lrelu(512, 256, 3)
+        self.conv_up1 = conv_bn_lrelu(256, 256, 3)
+        self.conv_up2 = conv_bn_lrelu(256, num_ch_enc[-1], 1)
+
+        self.convs = nn.ModuleDict()
+        for i in range(4, -1, -1):
+            cin = enc[-1] if i == 4 else dec[i + 1]
+            self.convs[_ref_key(("upconv", i, 0))] = ConvBlockT(cin, dec[i])
+            cin = dec[i] + (enc[i - 1] if i > 0 else 0)
+            self.convs[_ref_key(("upconv", i, 1))] = ConvBlockT(cin, dec[i])
+        for s in range(4):
+            self.convs[_ref_key(("dispconv", s))] = Conv3x3T(dec[s], 4)
+
+    def forward(self, features, disparity):
+        B, S = disparity.shape
+        emb = torch_embed(disparity.reshape(B * S, 1), self.multires)
+        emb = emb.unsqueeze(2).unsqueeze(3)  # [B*S, E, 1, 1]
+
+        x = features[-1]
+        x = self.conv_down1(self.downsample(x))
+        x = self.conv_down2(self.downsample(x))
+        x = self.conv_up1(F.interpolate(x, scale_factor=2, mode="nearest"))
+        x = self.conv_up2(F.interpolate(x, scale_factor=2, mode="nearest"))
+        x = x[:, :, :features[-1].shape[2], :features[-1].shape[3]]
+
+        def expand_cat(feat):
+            _, C, h, w = feat.shape
+            f = feat.unsqueeze(1).expand(B, S, C, h, w).reshape(B * S, C, h, w)
+            e = emb.expand(B * S, emb.shape[1], h, w)
+            return torch.cat([f, e], dim=1)
+
+        x = expand_cat(x)
+        outputs = {}
+        for i in range(4, -1, -1):
+            x = self.convs[_ref_key(("upconv", i, 0))](x)
+            x = F.interpolate(x, scale_factor=2, mode="nearest")
+            if i > 0:
+                x = torch.cat([x, expand_cat(features[i - 1])], dim=1)
+            x = self.convs[_ref_key(("upconv", i, 1))](x)
+            if i > 3:
+                continue  # heads exist for scales 0-3 only
+            out = self.convs[_ref_key(("dispconv", i))](x)
+            h, w = out.shape[2], out.shape[3]
+            mpi = out.view(B, S, 4, h, w)
+            rgb = torch.sigmoid(mpi[:, :, 0:3])
+            sigma = torch.sigmoid(mpi[:, :, 3:4]) if self.use_alpha \
+                else torch.abs(mpi[:, :, 3:4]) + 1e-4
+            outputs[i] = torch.cat([rgb, sigma], dim=2)
+        return [outputs[s] for s in range(4)]
+
+
+def randomize_bn_stats(module, rng):
+    """Non-trivial running statistics so eval-mode comparisons are strict."""
+    for m in module.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.copy_(torch.from_numpy(
+                rng.normal(scale=0.3, size=m.running_mean.shape).astype(
+                    np.float32)))
+            m.running_var.copy_(torch.from_numpy(
+                rng.uniform(0.5, 1.5, size=m.running_var.shape).astype(
+                    np.float32)))

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Two-process distributed smoke test (multi-host path on one machine).
+
+Each process is a simulated host with its own fake CPU devices; together they
+form one jax.distributed job. Exercises exactly the multi-host machinery the
+single-host tests cannot: jax.distributed.initialize rendezvous, the global
+("data","plane") mesh spanning processes, per-host batch shards assembled via
+make_array_from_process_local_data (SynthesisTrainer.put_batch), the
+GSPMD gradient/BN collectives across processes, and the all-process orbax
+checkpoint save.
+
+Run directly (spawns the second process itself):
+    python tools/multiprocess_smoke.py
+Exit code 0 + "MULTIPROCESS SMOKE OK" on success.
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PORT = int(os.environ.get("SMOKE_PORT", "12355"))
+NPROC = 2
+DEV_PER_PROC = 2
+
+
+def worker(process_id: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={DEV_PER_PROC}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"localhost:{PORT}",
+                               num_processes=NPROC,
+                               process_id=process_id)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mine_tpu.config import CONFIG_DIR, load_config
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.parallel.mesh import make_mesh
+    from mine_tpu.train.checkpoint import CheckpointManager
+    from mine_tpu.train.step import SynthesisTrainer
+
+    assert jax.process_count() == NPROC
+    assert len(jax.devices()) == NPROC * DEV_PER_PROC
+
+    config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+    config.update({
+        "data.img_h": 64, "data.img_w": 64,
+        "data.per_gpu_batch_size": 1,      # -> global batch 2 over data axis
+        "data.visible_point_count": 16,
+        "mpi.num_bins_coarse": 4,
+        "model.num_layers": 18,
+        "lr.decay_steps": [100],
+        "loss.smoothness_lambda_v1": 0.0,
+        "loss.smoothness_lambda_v2": 0.0,
+        "training.dtype": "float32",
+    })
+
+    mesh = make_mesh(data=2, plane=2)  # spans both processes
+    trainer = SynthesisTrainer(config, mesh=mesh, steps_per_epoch=10)
+
+    assert trainer.global_batch_size() == 2
+    assert trainer.local_batch_size() == 1
+
+    state = trainer.init_state(batch_size=trainer.global_batch_size())
+
+    # per-host shard: each process contributes a different example
+    full = make_batch(2, 64, 64, num_points=16, seed=0)
+    local = {k: v[process_id:process_id + 1] for k, v in full.items()}
+    batch = trainer.put_batch(local)
+    assert batch["src_img"].shape[0] == 2  # global view
+
+    state, metrics = trainer.train_step(state, batch)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), loss
+
+    # all-process checkpoint save of the multi-host-sharded state
+    ws = os.environ["SMOKE_WS"]
+    mgr = CheckpointManager(ws)
+    mgr.save_latest(state)
+    mgr.wait()
+    restored = mgr.restore(trainer.init_state(trainer.global_batch_size()))
+    assert restored is not None and int(restored.step) == 1
+
+    print(f"[proc {process_id}] step=1 loss={loss:.4f} OK", flush=True)
+    jax.distributed.shutdown()
+
+
+def main() -> int:
+    if "SMOKE_PROC_ID" in os.environ:
+        worker(int(os.environ["SMOKE_PROC_ID"]))
+        return 0
+
+    import tempfile
+    ws = tempfile.mkdtemp(prefix="mp_smoke_ws_")
+    env_base = dict(os.environ)
+    env_base["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon plugin out
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["SMOKE_WS"] = ws
+
+    import re
+    import tempfile as tf
+    import threading
+
+    procs = []
+    outputs = [None] * NPROC
+
+    def drain(pid, p):
+        outputs[pid] = p.stdout.read().decode()
+
+    threads = []
+    try:
+        for pid in range(NPROC):
+            env = dict(env_base)
+            env["SMOKE_PROC_ID"] = str(pid)
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            procs.append(p)
+            # drain both pipes concurrently: the workers are collectively
+            # coupled, so a full pipe on one blocks the other mid-collective
+            t = threading.Thread(target=drain, args=(pid, p), daemon=True)
+            t.start()
+            threads.append(t)
+
+        ok = True
+        for pid, p in enumerate(procs):
+            try:
+                p.wait(timeout=900)
+            except subprocess.TimeoutExpired:
+                ok = False
+                print(f"--- proc {pid} TIMED OUT ---")
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    losses = []
+    for pid, p in enumerate(procs):
+        text = outputs[pid] or ""
+        if p.returncode != 0:
+            ok = False
+            print(f"--- proc {pid} FAILED (rc={p.returncode}) ---")
+            print(text[-4000:])
+            continue
+        m = re.search(r"loss=([0-9.eE+-]+) OK", text)
+        if not m:
+            ok = False
+            print(f"--- proc {pid}: no loss line ---\n{text[-2000:]}")
+            continue
+        losses.append(float(m.group(1)))
+        print(f"[proc {pid}] loss={m.group(1)} OK")
+
+    # the decisive multi-host invariant: both processes computed the SAME
+    # global loss from different local shards
+    if ok and (len(losses) != NPROC or abs(losses[0] - losses[1]) > 1e-6):
+        ok = False
+        print(f"loss mismatch across processes: {losses}")
+
+    if ok:
+        print("MULTIPROCESS SMOKE OK")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,9 @@ class MPIConfig:
     # training.* / data.*
     src_rgb_blending: bool = True
     use_multi_scale: bool = True
+    # "xla" | "pallas_diff": backend for the novel-view composite inside the
+    # loss graph (pallas_diff = fused Pallas forward + custom-VJP backward)
+    composite_backend: str = "xla"
     use_disparity_loss: bool = True   # disp_lambda=0 for flowers/kitti_raw/dtu
     use_scale_factor: bool = True     # scale_factor=1 for flowers/kitti_raw/dtu
     img_h: int = 384
@@ -125,6 +128,14 @@ _NO_DISP_DATASETS = ("flowers", "kitti_raw", "dtu")
 def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
     g = config.get
     name = g("data.name", "llff")
+    backend = g("training.composite_backend", "xla")
+    # "pallas" (forward-only) is an internal render-path backend; the training
+    # loss graph differentiates through the composite, so only the custom-VJP
+    # variant is valid here.
+    if backend not in ("xla", "pallas_diff"):
+        raise ValueError(
+            f"training.composite_backend must be xla|pallas_diff, "
+            f"got {backend!r}")
     return MPIConfig(
         num_bins_coarse=g("mpi.num_bins_coarse", 32),
         num_bins_fine=g("mpi.num_bins_fine", 0),
@@ -143,6 +154,7 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         smoothness_grad_ratio=g("loss.smoothness_grad_ratio", 0.1),
         src_rgb_blending=g("training.src_rgb_blending", True),
         use_multi_scale=g("training.use_multi_scale", True),
+        composite_backend=backend,
         use_disparity_loss=name not in _NO_DISP_DATASETS,
         use_scale_factor=name not in _NO_DISP_DATASETS,
         img_h=g("data.img_h", 384),

@@ -116,8 +116,8 @@ class VideoGenerator:
         self.chunk = chunk
         if backend is None:
             # fused Pallas composite on TPU-class backends, XLA elsewhere
-            backend = "pallas" if jax.default_backend() in ("tpu", "axon") \
-                else "xla"
+            from mine_tpu.kernels import on_tpu_backend
+            backend = "pallas" if on_tpu_backend() else "xla"
         self.backend = backend
         H, W = self.cfg.img_h, self.cfg.img_w
 

@@ -1,2 +1,11 @@
 from mine_tpu.kernels.composite import (fused_src_render_blend,  # noqa: F401
                                         fused_volume_render)
+
+
+def on_tpu_backend() -> bool:
+    """True when the default JAX backend compiles Pallas TPU kernels natively
+    ("tpu", or this container's "axon" tunnel); elsewhere kernels run in
+    interpret mode."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")

@@ -167,13 +167,21 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
     tgt_sigma = warped[:, :, 3:4]
     tgt_xyz = warped[:, :, 4:7]
 
-    if backend == "pallas" and not use_alpha:
-        # fused forward-only composite (inference/eval): z-masking + volume
-        # rendering in one HBM pass (mine_tpu.kernels.composite)
-        from mine_tpu.kernels.composite import fused_volume_render
-        rgb_syn, depth_syn = fused_volume_render(
-            tgt_rgb, tgt_sigma, tgt_xyz, z_mask=True,
-            is_bg_depth_inf=is_bg_depth_inf)
+    if backend in ("pallas", "pallas_diff") and not use_alpha:
+        # fused composite: z-masking + volume rendering in one HBM pass
+        # (mine_tpu.kernels.composite). "pallas" is forward-only;
+        # "pallas_diff" adds the custom-VJP backward kernel for training.
+        from mine_tpu.kernels import on_tpu_backend
+        interp = not on_tpu_backend()
+        if backend == "pallas_diff":
+            from mine_tpu.kernels.composite_vjp import fused_volume_render_diff
+            rgb_syn, depth_syn = fused_volume_render_diff(
+                tgt_rgb, tgt_sigma, tgt_xyz, True, is_bg_depth_inf, interp)
+        else:
+            from mine_tpu.kernels.composite import fused_volume_render
+            rgb_syn, depth_syn = fused_volume_render(
+                tgt_rgb, tgt_sigma, tgt_xyz, z_mask=True,
+                is_bg_depth_inf=is_bg_depth_inf, interpret=interp)
     else:
         tgt_z = tgt_xyz[:, :, 2:3]
         tgt_sigma = jnp.where(tgt_z >= 0.0, tgt_sigma, 0.0)

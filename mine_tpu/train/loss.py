@@ -140,7 +140,8 @@ def loss_per_scale(scale: int,
     res = rendering.render_tgt_rgb_depth(
         mpi_rgb, mpi_sigma, disparity, xyz_tgt, G_render,
         K_src_inv, K_tgt,
-        use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf)
+        use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
+        backend=cfg.composite_backend)
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = 1.0 / res.depth
 

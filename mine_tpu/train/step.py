@@ -67,6 +67,15 @@ class SynthesisTrainer:
         self.mesh = mesh
         self.steps_per_epoch = steps_per_epoch
 
+        if mesh is not None and self.cfg.composite_backend != "xla" \
+                and mesh.shape.get(mesh_lib.PLANE_AXIS, 1) > 1:
+            # the Pallas composite kernels consume all S planes per tile and
+            # carry no SPMD partitioning spec yet — plane-sharded meshes must
+            # use the XLA composite (ROADMAP: shard_map wrapper)
+            raise ValueError(
+                "training.composite_backend=pallas_diff is incompatible with "
+                "parallel.plane_parallel > 1; use the XLA composite there")
+
         dtype_name = config.get("training.dtype", "bfloat16")
         dtype = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
         self.model = MPIPredictor(

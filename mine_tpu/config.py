@@ -111,6 +111,7 @@ class MPIConfig:
     # model.*
     pos_encoding_multires: int = 10
     num_layers: int = 50
+    sigma_dropout_rate: float = 0.0
     # optional explicit disparity bin edges (S+1 descending values); active
     # only when its length is num_bins_coarse+1 (synthesis_task.py:36,46)
     disparity_list: tuple = ()
@@ -161,5 +162,6 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         img_w=g("data.img_w", 512),
         pos_encoding_multires=g("model.pos_encoding_multires", 10),
         num_layers=g("model.num_layers", 50),
+        sigma_dropout_rate=float(g("model.sigma_dropout_rate", 0.0) or 0.0),
         disparity_list=tuple(float(d) for d in (g("mpi.disparity_list") or ())),
     )

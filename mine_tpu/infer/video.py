@@ -180,7 +180,9 @@ class VideoGenerator:
             backend=self.backend,
             warp_impl=warp_impl,
             warp_band=WARP_BAND)
-        return res.rgb, 1.0 / res.depth
+        # floor matches the loss graph's safe inversion: fully-transparent
+        # pixels composite to depth 0 and would otherwise make inf frames
+        return res.rgb, 1.0 / jnp.maximum(res.depth, 1e-8)
 
     def _max_row_block_span(self, poses_F44: np.ndarray,
                             rows_per_block: int = 8, step: int = 8) -> float:

@@ -34,10 +34,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _pick_tile_h(H: int, W: int, S: int) -> int:
-    """Largest H-tile (multiple of 8 or == H) keeping the block under ~4MB."""
-    budget = 4 * 1024 * 1024
-    per_row = S * 7 * W * 4  # rgb+sigma+xyz rows of one spatial row
+def _pick_tile_h(H: int, W: int, S: int,
+                 budget: int = 4 * 1024 * 1024,
+                 rows_per_plane: int = 7) -> int:
+    """Largest H-tile (multiple of 8 or == H) keeping the block under budget.
+
+    rows_per_plane = plane-sized f32 rows resident per spatial row (inputs +
+    outputs + scratch); the backward kernel passes a larger value."""
+    per_row = S * rows_per_plane * W * 4
     th = max(1, budget // max(per_row, 1))
     th = min(th, H)
     if th >= 8:

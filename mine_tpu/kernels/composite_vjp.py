@@ -25,26 +25,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mine_tpu.kernels.composite import fused_volume_render
+from mine_tpu.kernels.composite import _pick_tile_h, fused_volume_render
 
 
 def _pick_tile_h_bwd(H: int, W: int, S: int) -> int:
-    """Backward block: inputs+grads+outputs+scratch ~ 20 plane-sized rows."""
-    budget = 5 * 1024 * 1024
-    per_row = S * 20 * W * 4
-    th = max(1, budget // max(per_row, 1))
-    th = min(th, H)
-    if th >= 8:
-        th = (th // 8) * 8
-    while H % th != 0:
-        th -= 1
-    return max(th, 1)
+    """Backward block: inputs+grads+outputs+scratch ~ 19 plane-sized rows."""
+    return _pick_tile_h(H, W, S, budget=5 * 1024 * 1024, rows_per_plane=19)
 
 
 def _bwd_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
                 rgb_ref, sigma_ref, xyz_ref, g_rgb_ref, g_depth_ref,
                 d_rgb_ref, d_sigma_ref, d_xyz_ref,
-                trans_buf, tacc_buf, w_buf):
+                trans_buf, tacc_buf):
     TH, W = rgb_ref.shape[3], rgb_ref.shape[4]
 
     # ---- pass 1 (up): recompute transparency chain + output accumulators ----
@@ -65,7 +57,6 @@ def _bwd_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
         w = t_acc * (1.0 - trans)
         trans_buf[s] = trans
         tacc_buf[s] = t_acc
-        w_buf[s] = w
         acc_d = acc_d + w * xyz_s[2]
         acc_w = acc_w + w
         t_acc = t_acc * (trans + 1e-6)
@@ -90,7 +81,7 @@ def _bwd_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
         xyz_s = xyz_ref[0, s]
         trans = trans_buf[s]
         t_acc_s = tacc_buf[s]
-        w = w_buf[s]
+        w = t_acc_s * (1.0 - trans)  # recomputed: cheaper than a 3rd scratch
         z_s = xyz_s[2]
 
         dldw = (jnp.sum(g_rgb * rgb_ref[0, s], axis=0)
@@ -158,7 +149,6 @@ def _composite_bwd(rgb, sigma, xyz, g_rgb, g_depth,
             jax.ShapeDtypeStruct((B, S, 3, H, W), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((S, TH, W), jnp.float32),
             pltpu.VMEM((S, TH, W), jnp.float32),
             pltpu.VMEM((S, TH, W), jnp.float32),
         ],

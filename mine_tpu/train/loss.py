@@ -55,7 +55,7 @@ def compute_scale_factor(disparity_syn_pt3d: jnp.ndarray,
     Args: [B,1,N] each. Returns [B].
     """
     return jnp.exp(jnp.mean(
-        jnp.log(disparity_syn_pt3d) - jnp.log(pt3d_disp), axis=2))[:, 0]
+        _safe_log(disparity_syn_pt3d) - _safe_log(pt3d_disp), axis=2))[:, 0]
 
 
 def _project_points(K: jnp.ndarray, pt3d: jnp.ndarray) -> jnp.ndarray:
@@ -64,10 +64,27 @@ def _project_points(K: jnp.ndarray, pt3d: jnp.ndarray) -> jnp.ndarray:
     return p[:, 0:2] / p[:, 2:3]
 
 
+def _safe_log(x: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    """log with a floor: degenerate synthesized disparities (all planes
+    transparent at a pixel, e.g. under heavy sigma dropout -> depth ~ 0 ->
+    disparity -> inf/0) produce a huge-but-finite loss instead of inf/NaN
+    poisoning the parameters. The reference has no guard and infs there."""
+    return jnp.log(jnp.maximum(x, eps))
+
+
+def _safe_reciprocal_depth(depth: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    """depth -> disparity with a floor. A pixel where every plane is fully
+    transparent (sigma dropout can zero whole planes) composites to depth
+    exactly 0; the reference's torch.reciprocal returns inf there and the
+    loss NaNs. A finite 1/eps keeps training recoverable; no gradient flows
+    through floored pixels."""
+    return 1.0 / jnp.maximum(depth, eps)
+
+
 def _disp_loss(disp_syn_at_pts: jnp.ndarray, pt3d_disp: jnp.ndarray,
                scale_factor: jnp.ndarray) -> jnp.ndarray:
     scaled = disp_syn_at_pts / scale_factor[:, None, None]
-    return jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(pt3d_disp)))
+    return jnp.mean(jnp.abs(_safe_log(scaled) - _safe_log(pt3d_disp)))
 
 
 def loss_per_scale(scale: int,
@@ -117,7 +134,7 @@ def loss_per_scale(scale: int,
         src_syn, src_depth = rendering.weighted_sum_mpi(
             mpi_rgb, xyz_src, weights, is_bg_depth_inf=cfg.is_bg_depth_inf)
 
-    src_disp_syn = 1.0 / src_depth
+    src_disp_syn = _safe_reciprocal_depth(src_depth)
 
     # sparse-point disparity at src + scale factor
     if cfg.use_disparity_loss or cfg.use_scale_factor:
@@ -143,7 +160,7 @@ def loss_per_scale(scale: int,
         use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
         backend=cfg.composite_backend)
     tgt_syn, tgt_mask = res.rgb, res.mask
-    tgt_disp_syn = 1.0 / res.depth
+    tgt_disp_syn = _safe_reciprocal_depth(res.depth)
 
     # ---- loss terms ----
     zero = jnp.zeros((), jnp.float32)

@@ -67,14 +67,15 @@ class SynthesisTrainer:
         self.mesh = mesh
         self.steps_per_epoch = steps_per_epoch
 
-        if mesh is not None and self.cfg.composite_backend != "xla" \
-                and mesh.shape.get(mesh_lib.PLANE_AXIS, 1) > 1:
-            # the Pallas composite kernels consume all S planes per tile and
-            # carry no SPMD partitioning spec yet — plane-sharded meshes must
-            # use the XLA composite (ROADMAP: shard_map wrapper)
+        if mesh is not None and mesh.size > 1 \
+                and self.cfg.composite_backend != "xla":
+            # the Pallas composite kernels carry no SPMD partitioning spec yet
+            # (neither batch nor plane axis) — multi-device meshes must use
+            # the XLA composite (ROADMAP: shard_map wrapper)
             raise ValueError(
-                "training.composite_backend=pallas_diff is incompatible with "
-                "parallel.plane_parallel > 1; use the XLA composite there")
+                "training.composite_backend=pallas_diff requires a "
+                "single-device run; use the XLA composite on multi-device "
+                "meshes")
 
         dtype_name = config.get("training.dtype", "bfloat16")
         dtype = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
@@ -82,6 +83,7 @@ class SynthesisTrainer:
             num_layers=self.cfg.num_layers,
             pos_encoding_multires=self.cfg.pos_encoding_multires,
             use_alpha=self.cfg.use_alpha,
+            sigma_dropout_rate=self.cfg.sigma_dropout_rate,
             dtype=dtype)
         self.remat = bool(config.get("training.remat", False))
         self.tx = make_optimizer(config, steps_per_epoch)

@@ -102,3 +102,12 @@ def test_pallas_diff_composite_matches_xla_training():
     moved = [float(np.abs(np.asarray(a) - b).max())
              for a, b in zip(jax.tree_util.tree_leaves(s2.params), p_before)]
     assert max(moved) > 0
+
+
+def test_sigma_dropout_step():
+    """model.sigma_dropout_rate drops whole planes during training; the step
+    stays finite and the dropout rng is threaded (depth_decoder.py:143-144)."""
+    cfg = tiny_config()
+    cfg["model.sigma_dropout_rate"] = 0.3
+    _, m = _one_step(cfg)
+    assert np.isfinite(m["loss"]), m

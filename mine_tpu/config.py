@@ -104,6 +104,11 @@ class MPIConfig:
     # "xla" | "pallas_diff": backend for the novel-view composite inside the
     # loss graph (pallas_diff = fused Pallas forward + custom-VJP backward)
     composite_backend: str = "xla"
+    # "xla" | "pallas_diff": backend for the training-path homography warp
+    # ("pallas_diff" = banded MXU kernel fwd+bwd with a runtime gather
+    # fallback for rotation-heavy poses; kernels/warp_vjp.py)
+    warp_backend: str = "xla"
+    warp_band: int = 32
     use_disparity_loss: bool = True   # disp_lambda=0 for flowers/kitti_raw/dtu
     use_scale_factor: bool = True     # scale_factor=1 for flowers/kitti_raw/dtu
     img_h: int = 384
@@ -137,6 +142,11 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         raise ValueError(
             f"training.composite_backend must be xla|pallas_diff, "
             f"got {backend!r}")
+    warp_backend = g("training.warp_backend", "xla")
+    if warp_backend not in ("xla", "pallas_diff"):
+        raise ValueError(
+            f"training.warp_backend must be xla|pallas_diff, "
+            f"got {warp_backend!r}")
     return MPIConfig(
         num_bins_coarse=g("mpi.num_bins_coarse", 32),
         num_bins_fine=g("mpi.num_bins_fine", 0),
@@ -156,6 +166,8 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         src_rgb_blending=g("training.src_rgb_blending", True),
         use_multi_scale=g("training.use_multi_scale", True),
         composite_backend=backend,
+        warp_backend=warp_backend,
+        warp_band=int(g("training.warp_band", 32)),
         use_disparity_loss=name not in _NO_DISP_DATASETS,
         use_scale_factor=name not in _NO_DISP_DATASETS,
         img_h=g("data.img_h", 384),

@@ -24,7 +24,9 @@ headroom per block. `band_span` computes the actual span for a coordinate
 field so callers with host-known poses (e.g. the video renderer) can pick
 the kernel or the XLA path per call. Coordinates outside the image follow
 grid_sample(border) semantics, matching ops/warp.bilinear_sample.
-Forward-only (inference/eval); training keeps the autodiffed XLA path.
+This module is the forward kernel; kernels/warp_vjp.py pairs it with a
+transposed-band backward kernel (custom VJP) so training can use it too
+(`training.warp_backend: pallas_diff`).
 """
 
 from __future__ import annotations

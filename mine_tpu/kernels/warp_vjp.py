@@ -1,0 +1,235 @@
+"""Differentiable banded warp: Pallas forward AND Pallas backward.
+
+Makes the banded bilinear-gather kernel (kernels.warp) usable in the
+TRAINING path, replacing the vmapped per-pixel gather (ops/warp.py
+bilinear_sample) whose scatter/gather lowering is the worst-case TPU memory
+pattern for the reference's hot warp op (homography_sampler.py:138 over a
+B*S x 7 x H x W volume, called from mpi_rendering.py:214).
+
+Key observation for the backward pass: the adjoint of bilinear sampling is
+bilinear *splatting* with the same coordinates —
+
+  d_src[c,h,w] = sum_{r,wt} g[c,r,wt] * wy(h; sy[r,wt]) * wx(w; sx[r,wt]),
+  wy(h; s) = max(1 - |h - s|, 0)   (tent), wx likewise
+
+— and because the inverse of a plane homography is itself a homography, the
+set of *target* rows r that touch a block of *source* rows is a narrow band,
+exactly mirroring the forward's band structure. The backward kernel walks
+source row-blocks, DMAs the touching band of gradient rows from HBM, and
+contracts with transposed one-hot tent weights on the MXU: per gradient row
+an [C*RS, W_t] @ [W_t, W_s] matmul. No scatter instructions at all.
+
+Correctness domain (checked, not assumed): the forward needs each target
+row-block's source-y span to fit its band; the backward needs each source
+row-block's touching-target-row span to fit `oband`. `diff_domain_ok`
+computes both inside jit; `bilinear_sample_diff_guarded` wraps the whole
+thing in `lax.cond`, falling back to the autodiffed XLA gather when a pose
+is too rotation-heavy for the band — so the training step is correct for
+ALL poses and fast for the (dominant) translation-dominated ones.
+
+Gradients flow to `src` only. The homography coordinates are non-learnable
+in MINE training: they derive from sampled disparities, dataset poses, and
+the no-grad homography inverse (homography_sampler.py:112-113; the
+scale-factor pose edit is also no-grad, synthesis_task.py:441-442), and the
+caller (ops/warp.homography_warp) stop-gradients them. The VJP therefore
+returns zero cotangents for coords, and a test gates this against jax.grad
+of the gather path (tests/test_warp_vjp.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mine_tpu.kernels.warp import band_span, pallas_bilinear_sample
+
+
+def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
+                o0_ref, g_ref, xc_ref, yc_ref, out_ref,
+                g_buf, xc_buf, yc_buf, sem_g, sem_x, sem_y):
+    """Grid step (b, source-row-block): splat OBAND gradient rows into RS
+    source rows via transposed tent-weight contractions."""
+    W_s = out_ref.shape[3]
+    o0 = o0_ref[0, 0]
+    sb = pl.program_id(1)
+    h0 = (sb * RS).astype(jnp.float32)
+
+    dma_g = pltpu.make_async_copy(
+        g_ref.at[0, :, pl.ds(o0, OBAND), :], g_buf, sem_g)
+    dma_x = pltpu.make_async_copy(
+        xc_ref.at[0, pl.ds(o0, OBAND), :], xc_buf, sem_x)
+    dma_y = pltpu.make_async_copy(
+        yc_ref.at[0, pl.ds(o0, OBAND), :], yc_buf, sem_y)
+    dma_g.start(); dma_x.start(); dma_y.start()
+    dma_g.wait(); dma_x.wait(); dma_y.wait()
+
+    # source-x positions along the lane axis, per gradient row's sample x
+    ws = jax.lax.broadcasted_iota(jnp.float32, (W_t, W_s), 1)
+    # source rows of this block, relative iota + h0
+    hs = jax.lax.broadcasted_iota(jnp.float32, (RS, W_t), 0) + h0
+
+    accum = jnp.zeros((C * RS, W_s), jnp.float32)
+    for ob in range(OBAND):
+        sx = xc_buf[ob:ob + 1, :]                       # [1, W_t]
+        sy = yc_buf[ob:ob + 1, :]                       # [1, W_t]
+        wy = jnp.maximum(1.0 - jnp.abs(hs - sy), 0.0)   # [RS, W_t]
+        m = g_buf[:, ob, :][:, None, :] * wy[None]      # [C, RS, W_t]
+        wxT = jnp.maximum(1.0 - jnp.abs(ws - sx.T), 0.0)  # [W_t, W_s]
+        accum = accum + jnp.dot(m.reshape(C * RS, W_t), wxT,
+                                preferred_element_type=jnp.float32)
+    out_ref[0] = accum.reshape(C, RS, W_s)
+
+
+def _touch_bounds(yc: jnp.ndarray, H_s: int, rows_per_block: int):
+    """Per (plane, source-row-block): first/last target row whose samples
+    touch the block, plus whether any does. yc must be border-clipped."""
+    Bp, H_t, _ = yc.shape
+    NBs = H_s // rows_per_block
+    ymin = jnp.min(yc, axis=2)  # [Bp, H_t]
+    ymax = jnp.max(yc, axis=2)
+    h0 = (jnp.arange(NBs, dtype=jnp.float32) * rows_per_block)[None, None]
+    # tent support: target row r touches source row h iff |h - sy| < 1
+    touches = ((ymax[:, :, None] > h0 - 1.0)
+               & (ymin[:, :, None] < h0 + rows_per_block))  # [Bp, H_t, NBs]
+    first = jnp.argmax(touches, axis=1)  # [Bp, NBs]
+    last = H_t - 1 - jnp.argmax(touches[:, ::-1], axis=1)
+    any_touch = jnp.any(touches, axis=1)
+    return first, last, any_touch
+
+
+def _clip_coords(src_shape, coords_x, coords_y):
+    _, _, H_s, W_s = src_shape
+    xc = jnp.clip(coords_x, 0.0, W_s - 1.0).astype(jnp.float32)
+    yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
+    return xc, yc
+
+
+@functools.partial(jax.jit, static_argnames=("src_shape", "oband",
+                                             "rows_per_block", "interpret"))
+def _warp_bwd(g, coords_x, coords_y, src_shape,
+              oband: int, rows_per_block: int, interpret: bool):
+    Bp, C, H_s, W_s = src_shape
+    _, H_t, W_t = coords_x.shape
+    RS = rows_per_block
+    assert H_s % RS == 0, (H_s, RS)
+    NBs = H_s // RS
+    oband = min(oband, H_t)
+
+    xc, yc = _clip_coords(src_shape, coords_x, coords_y)
+    first, _, any_touch = _touch_bounds(yc, H_s, RS)
+    o0 = jnp.where(any_touch, first, 0)
+    o0 = jnp.clip(o0, 0, max(H_t - oband, 0)).astype(jnp.int32)  # [Bp, NBs]
+
+    kernel = functools.partial(_bwd_kernel, C, oband, RS, H_t, W_t)
+    return pl.pallas_call(
+        kernel,
+        grid=(Bp, NBs),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, s: (b, s),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, C, H_t, W_t), lambda b, s: (b, 0, 0, 0),
+                         memory_space=pl.ANY),   # gradient stays in HBM
+            pl.BlockSpec((1, H_t, W_t), lambda b, s: (b, 0, 0),
+                         memory_space=pl.ANY),
+            pl.BlockSpec((1, H_t, W_t), lambda b, s: (b, 0, 0),
+                         memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, C, RS, W_s), lambda b, s: (b, 0, s, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Bp, C, H_s, W_s), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((C, oband, W_t), jnp.float32),
+            pltpu.VMEM((oband, W_t), jnp.float32),
+            pltpu.VMEM((oband, W_t), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(o0, g.astype(jnp.float32), xc, yc)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def bilinear_sample_diff(src, coords_x, coords_y,
+                         band: int = 32,
+                         oband: int = 32,
+                         rows_per_block: int = 8,
+                         interpret: bool = False):
+    """Differentiable banded bilinear sample: Pallas fwd + Pallas bwd.
+
+    Same contract as ops.warp.bilinear_sample within the band domain (see
+    module docstring; use `bilinear_sample_diff_guarded` for unconditional
+    correctness). Gradient flows to src; coords receive zeros."""
+    return pallas_bilinear_sample(src, coords_x, coords_y, band=band,
+                                  rows_per_block=rows_per_block,
+                                  interpret=interpret)
+
+
+def _diff_fwd(src, coords_x, coords_y, band, oband, rows_per_block, interpret):
+    out = pallas_bilinear_sample(src, coords_x, coords_y, band=band,
+                                 rows_per_block=rows_per_block,
+                                 interpret=interpret)
+    return out, (src.shape, coords_x, coords_y)
+
+
+def _diff_bwd(band, oband, rows_per_block, interpret, residuals, g):
+    src_shape, coords_x, coords_y = residuals
+    d_src = _warp_bwd(g, coords_x, coords_y, src_shape=src_shape,
+                      oband=oband, rows_per_block=rows_per_block,
+                      interpret=interpret)
+    return d_src, jnp.zeros_like(coords_x), jnp.zeros_like(coords_y)
+
+
+bilinear_sample_diff.defvjp(_diff_fwd, _diff_bwd)
+
+
+def diff_domain_ok(src_shape, coords_y, band: int, oband: int,
+                   rows_per_block: int = 8) -> jnp.ndarray:
+    """Scalar bool (jit-safe): both kernels' band assumptions hold.
+
+    Forward: each target row-block's source-y span needs <= band-2 rows
+    (kernels.warp docstring). Backward: each source row-block's touching
+    target-row span needs <= oband rows."""
+    _, _, H_s, W_s = src_shape
+    yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
+    fwd_ok = band_span(yc, H_s, rows_per_block) + 2.0 <= min(band, H_s)
+
+    first, last, any_touch = _touch_bounds(yc, H_s, rows_per_block)
+    span = jnp.where(any_touch, last - first + 1, 0)
+    bwd_ok = jnp.max(span) <= min(oband, coords_y.shape[1])
+    return jnp.logical_and(fwd_ok, bwd_ok)
+
+
+def bilinear_sample_diff_guarded(src, coords_x, coords_y,
+                                 band: int = 32,
+                                 oband: int = 32,
+                                 rows_per_block: int = 8,
+                                 interpret: bool = False):
+    """Banded differentiable warp with a runtime XLA-gather fallback.
+
+    `lax.cond` on the (data-dependent, pose-derived) band-domain check: the
+    Pallas fast path for translation-dominated warps, the autodiffed gather
+    for rotation-heavy ones. Both branches are differentiable, so this
+    composes with jax.grad in the training step. Always returns float32
+    (the kernel's accumulation dtype) so the two cond branches agree."""
+    from mine_tpu.ops.warp import bilinear_sample
+
+    src = src.astype(jnp.float32)
+    H_t = coords_x.shape[1]
+    if H_t % rows_per_block != 0 or src.shape[2] % rows_per_block != 0:
+        return bilinear_sample(src, coords_x, coords_y)
+
+    # The domain check recomputes coord min/max that the VJP's o0 derivation
+    # also needs; both live in one XLA module per train step (CSE'd or not,
+    # they are elementwise reductions — negligible next to the conv stack).
+    ok = diff_domain_ok(src.shape, coords_y, band, oband, rows_per_block)
+    return jax.lax.cond(
+        ok,
+        lambda s, x, y: bilinear_sample_diff(
+            s, x, y, band, oband, rows_per_block, interpret),
+        lambda s, x, y: bilinear_sample(s, x, y),
+        src, coords_x, coords_y)

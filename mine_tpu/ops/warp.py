@@ -94,8 +94,10 @@ def homography_warp(src_BCHW: jnp.ndarray,
       G_tgt_src: [B', 4, 4]
       K_src_inv, K_tgt: [B', 3, 3]
       meshgrid_tgt: [3, Ht, Wt] homogeneous target pixel grid
-      impl: "xla" (gather; autodiffed) or "pallas" (banded MXU gather kernel,
-        forward-only; caller must validate the band via kernels.warp.band_span)
+      impl: "xla" (gather; autodiffed), "pallas" (banded MXU gather kernel,
+        forward-only; caller must validate the band via
+        kernels.warp.band_span), or "pallas_diff" (banded fwd+bwd kernels
+        with a built-in runtime gather fallback — the training backend)
     Returns:
       tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
     """
@@ -116,6 +118,16 @@ def homography_warp(src_BCHW: jnp.ndarray,
     if impl == "pallas":
         from mine_tpu.kernels.warp import pallas_bilinear_sample
         tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band)
+    elif impl == "pallas_diff":
+        # training path: banded Pallas fwd+bwd with runtime gather fallback
+        # outside the band domain (kernels/warp_vjp.py). Coords are
+        # non-learnable (no-grad inverse above), so stop_gradient keeps the
+        # two branches' autodiff structurally identical.
+        from mine_tpu.kernels import on_tpu_backend
+        from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
+        tgt = bilinear_sample_diff_guarded(
+            src_BCHW, jax.lax.stop_gradient(x), jax.lax.stop_gradient(y),
+            band=band, oband=band, interpret=not on_tpu_backend())
     else:
         tgt = bilinear_sample(src_BCHW, x, y)
     return tgt, valid

@@ -158,7 +158,8 @@ def loss_per_scale(scale: int,
         mpi_rgb, mpi_sigma, disparity, xyz_tgt, G_render,
         K_src_inv, K_tgt,
         use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
-        backend=cfg.composite_backend)
+        backend=cfg.composite_backend,
+        warp_impl=cfg.warp_backend, warp_band=cfg.warp_band)
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = _safe_reciprocal_depth(res.depth)
 

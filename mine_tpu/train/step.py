@@ -68,14 +68,15 @@ class SynthesisTrainer:
         self.steps_per_epoch = steps_per_epoch
 
         if mesh is not None and mesh.size > 1 \
-                and self.cfg.composite_backend != "xla":
-            # the Pallas composite kernels carry no SPMD partitioning spec yet
+                and (self.cfg.composite_backend != "xla"
+                     or self.cfg.warp_backend != "xla"):
+            # the Pallas kernels carry no SPMD partitioning spec yet
             # (neither batch nor plane axis) — multi-device meshes must use
-            # the XLA composite (ROADMAP: shard_map wrapper)
+            # the XLA paths (ROADMAP: shard_map wrapper)
             raise ValueError(
-                "training.composite_backend=pallas_diff requires a "
-                "single-device run; use the XLA composite on multi-device "
-                "meshes")
+                "training.composite_backend/warp_backend=pallas_diff "
+                "requires a single-device run; use the XLA paths on "
+                "multi-device meshes")
 
         dtype_name = config.get("training.dtype", "bfloat16")
         dtype = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]

@@ -104,6 +104,32 @@ def test_pallas_diff_composite_matches_xla_training():
     assert max(moved) > 0
 
 
+def test_pallas_diff_warp_matches_xla_training():
+    """training.warp_backend=pallas_diff: one full train step through the
+    banded warp (fwd kernel + transposed-band VJP kernel, interpret mode on
+    CPU) must match the gather-path step numerically (VERDICT r1 item 3)."""
+    cfg = tiny_config()
+    batch = to_jnp(make_batch(1, 64, 64, num_points=16))
+    t_xla = SynthesisTrainer(cfg, steps_per_epoch=10)
+    s0 = t_xla.init_state(batch_size=1)
+    _, m_xla = t_xla.train_step(s0, batch)
+
+    cfg_w = dict(cfg)
+    cfg_w["training.warp_backend"] = "pallas_diff"
+    t_w = SynthesisTrainer(cfg_w, steps_per_epoch=10)
+    s1 = t_w.init_state(batch_size=1)
+    p_before = [np.array(x) for x in jax.tree_util.tree_leaves(s1.params)]
+    s2, m_w = t_w.train_step(s1, batch)
+
+    np.testing.assert_allclose(float(m_w["loss"]), float(m_xla["loss"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(m_w["loss_rgb_tgt"]),
+                               float(m_xla["loss_rgb_tgt"]), rtol=1e-4)
+    moved = [float(np.abs(np.asarray(a) - b).max())
+             for a, b in zip(jax.tree_util.tree_leaves(s2.params), p_before)]
+    assert max(moved) > 0
+
+
 def test_sigma_dropout_step():
     """model.sigma_dropout_rate drops whole planes during training; the step
     stays finite and the dropout rng is threaded (depth_decoder.py:143-144)."""

@@ -1,0 +1,148 @@
+"""Gradient gate for the differentiable banded warp (kernels.warp_vjp):
+forward must match the XLA bilinear sampler and the custom-VJP backward must
+match jax.grad of the gather path — interpret mode on CPU; the same kernels
+compile for TPU (VERDICT round 1 item 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mine_tpu.kernels.warp_vjp import (bilinear_sample_diff,
+                                       bilinear_sample_diff_guarded,
+                                       diff_domain_ok)
+from mine_tpu.ops import warp
+
+
+def _mild_coords(rng, Bp, H, W):
+    """Translation-dominated warp coords (the training regime)."""
+    yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+    x = xx[None] + rng.uniform(-4, 4, (Bp, 1, 1)).astype(np.float32) \
+        + 0.02 * yy[None]
+    y = yy[None] + rng.uniform(-3, 3, (Bp, 1, 1)).astype(np.float32) \
+        + 0.03 * xx[None]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _rotation_heavy_coords(rng, Bp, H, W):
+    """Steep slope: source-y span per row-block far exceeds any band."""
+    yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+    x = xx[None] + 0.0 * yy[None] + np.zeros((Bp, 1, 1), np.float32)
+    y = yy[None] + 0.9 * xx[None] + np.zeros((Bp, 1, 1), np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_forward_matches_gather():
+    rng = np.random.RandomState(0)
+    Bp, C, H, W = 2, 7, 32, 48
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _mild_coords(rng, Bp, H, W)
+    ref = warp.bilinear_sample(src, x, y)
+    out = bilinear_sample_diff(src, x, y, 16, 16, 8, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_grad_matches_gather_path():
+    """d(loss)/d(src) of the Pallas pair == jax.grad through the XLA gather."""
+    rng = np.random.RandomState(1)
+    Bp, C, H, W = 2, 5, 32, 48
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _mild_coords(rng, Bp, H, W)
+    cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+
+    def loss_ref(s):
+        return jnp.sum(warp.bilinear_sample(s, x, y) * cot)
+
+    def loss_ker(s):
+        return jnp.sum(bilinear_sample_diff(s, x, y, 16, 16, 8, True) * cot)
+
+    g_ref = jax.grad(loss_ref)(src)
+    g_ker = jax.grad(loss_ker)(src)
+    np.testing.assert_allclose(np.asarray(g_ker), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_grad_with_border_clamping():
+    """Out-of-image samples: border-clamped weights concentrate gradient on
+    edge pixels identically in both paths."""
+    rng = np.random.RandomState(2)
+    Bp, C, H, W = 1, 3, 16, 32
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+    x = jnp.asarray((xx[None] + rng.uniform(-8, 8, (Bp, H, W))).astype(np.float32))
+    y = jnp.asarray((yy[None] + rng.uniform(-2, 2, (Bp, H, W))).astype(np.float32))
+    cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+
+    g_ref = jax.grad(lambda s: jnp.sum(warp.bilinear_sample(s, x, y) * cot))(src)
+    g_ker = jax.grad(lambda s: jnp.sum(
+        bilinear_sample_diff(s, x, y, 16, 16, 8, True) * cot))(src)
+    np.testing.assert_allclose(np.asarray(g_ker), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_domain_check_classifies():
+    rng = np.random.RandomState(3)
+    Bp, C, H, W = 2, 3, 32, 48
+    shape = (Bp, C, H, W)
+    _, y_ok = _mild_coords(rng, Bp, H, W)
+    _, y_bad = _rotation_heavy_coords(rng, Bp, H, W)
+    assert bool(diff_domain_ok(shape, y_ok, 16, 16, 8))
+    assert not bool(diff_domain_ok(shape, y_bad, 16, 16, 8))
+
+
+def test_guarded_fallback_is_exact():
+    """Rotation-heavy coords take the gather branch: value AND grad equal the
+    XLA path exactly, so training stays correct for every pose."""
+    rng = np.random.RandomState(4)
+    Bp, C, H, W = 1, 4, 32, 48
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _rotation_heavy_coords(rng, Bp, H, W)
+    cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+
+    def loss_g(s):
+        return jnp.sum(bilinear_sample_diff_guarded(
+            s, x, y, band=16, oband=16, interpret=True) * cot)
+
+    out = bilinear_sample_diff_guarded(src, x, y, band=16, oband=16,
+                                       interpret=True)
+    ref = warp.bilinear_sample(src, x, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    g = jax.grad(loss_g)(src)
+    g_ref = jax.grad(lambda s: jnp.sum(warp.bilinear_sample(s, x, y) * cot))(src)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_guarded_fast_path_under_jit():
+    """In-domain coords inside jit: guarded == gather for value and grad."""
+    rng = np.random.RandomState(5)
+    Bp, C, H, W = 2, 7, 24, 32
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _mild_coords(rng, Bp, H, W)
+    cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+
+    @jax.jit
+    def f(s):
+        return jnp.sum(bilinear_sample_diff_guarded(
+            s, x, y, band=16, oband=16, interpret=True) * cot)
+
+    v, g = jax.value_and_grad(f)(src)
+    v_ref = jnp.sum(warp.bilinear_sample(src, x, y) * cot)
+    g_ref = jax.grad(lambda s: jnp.sum(warp.bilinear_sample(s, x, y) * cot))(src)
+    np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_coord_cotangents_are_zero():
+    """Coords are non-learnable in MINE (module docstring); the VJP must
+    return zero cotangents rather than garbage."""
+    rng = np.random.RandomState(6)
+    Bp, C, H, W = 1, 2, 16, 32
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _mild_coords(rng, Bp, H, W)
+
+    gx = jax.grad(lambda xx: jnp.sum(
+        bilinear_sample_diff(src, xx, y, 16, 16, 8, True)))(x)
+    assert float(jnp.max(jnp.abs(gx))) == 0.0

@@ -62,7 +62,8 @@ def main():
 
     lpips_params = lpips_mod.load_params(lpips_mod.default_weights_path())
     if lpips_params is None:
-        logger.info("LPIPS weights not found; lpips metric will read 0")
+        logger.info("LPIPS weights not found; lpips metric omitted "
+                    "(reported as NaN internally, never 0)")
 
     trainer = SynthesisTrainer(config, steps_per_epoch=1,
                                lpips_params=lpips_params)
@@ -93,7 +94,14 @@ def main():
         jax.profiler.stop_trace()
         logger.info("profiler trace written to %s", args.profile_dir)
 
-    print(json.dumps({k: round(v, 6) for k, v in results.items()}))
+    # NaN-valued metrics (e.g. LPIPS without weights) are omitted from the
+    # JSON rather than emitted as invalid-JSON NaN tokens or a fake 0.0
+    import math
+    out = {k: round(v, 6) for k, v in results.items() if not math.isnan(v)}
+    skipped = sorted(k for k, v in results.items() if math.isnan(v))
+    if skipped:
+        out["missing_metrics"] = skipped
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

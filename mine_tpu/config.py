@@ -168,8 +168,12 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         composite_backend=backend,
         warp_backend=warp_backend,
         warp_band=int(g("training.warp_band", 32)),
-        use_disparity_loss=name not in _NO_DISP_DATASETS,
-        use_scale_factor=name not in _NO_DISP_DATASETS,
+        # visible_point_count == 0 also disables the sparse-point terms —
+        # datasets with no SfM points (public RealEstate10K) train scale-free
+        use_disparity_loss=(name not in _NO_DISP_DATASETS
+                            and int(g("data.visible_point_count", 256) or 0) > 0),
+        use_scale_factor=(name not in _NO_DISP_DATASETS
+                          and int(g("data.visible_point_count", 256) or 0) > 0),
         img_h=g("data.img_h", 384),
         img_w=g("data.img_w", 512),
         pos_encoding_multires=g("model.pos_encoding_multires", 10),

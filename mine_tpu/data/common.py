@@ -1,0 +1,56 @@
+"""Shared batching machinery for all dataset loaders.
+
+One implementation of shuffle -> host-shard -> collate (the reference's
+DistributedSampler + DataLoader + collate + set_data L=1 squeeze,
+train.py:83-87, synthesis_task.py:184-209) used by the LLFF, RealEstate10K,
+and synthetic loaders, so the semantics (shuffle the GLOBAL index list with
+the epoch-seeded RNG, then stride-shard across hosts — DistributedSampler
+order) cannot drift between them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Tuple
+
+import numpy as np
+
+
+def iterate_pair_batches(num_items: int,
+                         get_pair: Callable[[int, np.random.RandomState],
+                                            Tuple[Dict, Dict]],
+                         batch_size: int,
+                         shuffle: bool,
+                         seed: int = 0,
+                         epoch: int = 0,
+                         drop_last: bool = True,
+                         shard_index: int = 0,
+                         num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield collated framework batches of (src, tgt) item pairs."""
+    order = np.arange(num_items)
+    if shuffle:
+        np.random.RandomState(seed + epoch).shuffle(order)
+    order = order[shard_index::num_shards]
+
+    rng = np.random.RandomState((seed + 1) * 7919 + epoch)
+    batch: List = []
+    for idx in order:
+        batch.append(get_pair(int(idx), rng))
+        if len(batch) == batch_size:
+            yield collate_pairs(batch)
+            batch = []
+    if batch and not drop_last:
+        yield collate_pairs(batch)
+
+
+def collate_pairs(pairs) -> Dict[str, np.ndarray]:
+    """(src, tgt) item dicts -> the framework batch contract (NHWC images,
+    [B,3,3] intrinsics, [B,4,4] src<-tgt pose, [B,3,N] camera-frame points)."""
+    return {
+        "src_img": np.stack([s["img"] for s, _ in pairs]),
+        "tgt_img": np.stack([t["img"] for _, t in pairs]),
+        "K_src": np.stack([s["K"] for s, _ in pairs]),
+        "K_tgt": np.stack([t["K"] for _, t in pairs]),
+        "G_src_tgt": np.stack([t["G_src_tgt"] for _, t in pairs]),
+        "pt3d_src": np.stack([s["xyzs"] for s, _ in pairs]),
+        "pt3d_tgt": np.stack([t["xyzs"] for _, t in pairs]),
+    }

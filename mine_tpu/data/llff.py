@@ -207,34 +207,16 @@ class LLFFDataset:
         Equivalent to DistributedSampler(set_epoch) + DataLoader + collate +
         set_data's L=1 squeeze (train.py:83-87, synthesis_task.py:184-209).
         """
-        order = np.arange(len(self.infos))
-        if shuffle:
-            np.random.RandomState(seed + epoch).shuffle(order)
-        order = order[shard_index::num_shards]
+        from mine_tpu.data.common import iterate_pair_batches
 
-        rng = np.random.RandomState((seed + 1) * 7919 + epoch)
-        batch: List = []
-        for idx in order:
-            src, tgts = self.get_item(int(idx), rng)
-            tgt = tgts[0]
-            batch.append((src, tgt))
-            if len(batch) == batch_size:
-                yield self._collate(batch)
-                batch = []
-        if batch and not drop_last:
-            yield self._collate(batch)
+        def get_pair(idx, rng):
+            src, tgts = self.get_item(idx, rng)
+            return src, tgts[0]
 
-    @staticmethod
-    def _collate(pairs) -> Dict[str, np.ndarray]:
-        return {
-            "src_img": np.stack([s["img"] for s, _ in pairs]),
-            "tgt_img": np.stack([t["img"] for _, t in pairs]),
-            "K_src": np.stack([s["K"] for s, _ in pairs]),
-            "K_tgt": np.stack([t["K"] for _, t in pairs]),
-            "G_src_tgt": np.stack([t["G_src_tgt"] for _, t in pairs]),
-            "pt3d_src": np.stack([s["xyzs"] for s, _ in pairs]),
-            "pt3d_tgt": np.stack([t["xyzs"] for _, t in pairs]),
-        }
+        yield from iterate_pair_batches(
+            len(self.infos), get_pair, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)
 
 
 def get_dataset(config: Dict, logger=None) -> Tuple[LLFFDataset, LLFFDataset]:
@@ -242,12 +224,45 @@ def get_dataset(config: Dict, logger=None) -> Tuple[LLFFDataset, LLFFDataset]:
     (train.py:69-103). Only the LLFF/COLMAP loader exists upstream; other
     dataset names raise NotImplementedError there too (train.py:100-101)."""
     name = config["data.name"]
+    if name == "synthetic":
+        # procedural scene, no files needed: smoke-tests the full
+        # train/eval/CLI stack (mine_tpu.data.synthetic)
+        from mine_tpu.data.synthetic import SyntheticPairDataset
+        mk = lambda seed: SyntheticPairDataset(  # noqa: E731
+            num_views=int(config.get("data.num_seq_per_gpu", 4)) + 2,
+            num_points=int(config.get("data.visible_point_count", 256)),
+            height=int(config["data.img_h"]),
+            width=int(config["data.img_w"]),
+            seed=seed)
+        return mk(0), mk(1)
+    if name == "realestate10k":
+        # capability beyond the reference (its get_dataset raises for
+        # everything but llff, train.py:100-101) — see data/realestate10k.py
+        from mine_tpu.data.realestate10k import RealEstate10KDataset
+        common = dict(
+            img_size=(config["data.img_w"], config["data.img_h"]),
+            # default matches mpi_config_from_dict (256): a missing key must
+            # not silently pair dummy points with an enabled disparity loss
+            visible_points_count=config.get("data.visible_point_count", 256),
+            frames_apart=config.get("testing.frames_apart", "random"),
+            max_frame_gap=config.get("data.max_frame_gap", 30),
+            points_root=config.get("data.points_root"),
+            logger=logger)
+        train = RealEstate10KDataset(
+            root=config["data.training_set_path"],
+            is_validation=False, **common)
+        val = RealEstate10KDataset(
+            root=config["data.val_set_path"],
+            is_validation=True,
+            pairs_json=config.get("data.val_pairs_json"),
+            tgt_key=config.get("data.val_pairs_tgt", "tgt_img_obj_5_frames"),
+            **common)
+        return train, val
     if name != "llff":
         raise NotImplementedError(
             f"dataset '{name}': the reference ships only the LLFF/COLMAP "
             f"loader (train.py:100-101); config parity for "
-            f"realestate10k/kitti_raw/flowers/dtu is provided, their loaders "
-            f"are not")
+            f"kitti_raw/flowers/dtu is provided, their loaders are not")
     train = LLFFDataset(
         root=config["data.training_set_path"],
         is_validation=False,

@@ -1,0 +1,311 @@
+"""RealEstate10K dataset — video-sequence pairs, RAM-cached, host-sharded.
+
+Capability beyond the reference's code: its released-model grid is headlined
+by RealEstate10K (README.md:43-46) and it ships the eval-pair protocol file
+(input_pipelines/realestate10k/test_data_jsons/validation_pairs.json), but
+its get_dataset raises NotImplementedError for everything except LLFF
+(train.py:100-101). This loader supplies the missing pipeline with the same
+batch contract as data/llff.py, so the whole trainer/eval stack works
+unchanged.
+
+On-disk layout (the public dataset's standard extraction):
+  <root>/<seq>.txt            camera file: line 1 = video URL; each further
+                              line = ts fx fy cx cy k1 k2 r11 r12 r13 t1 r21
+                              ... t3 (normalized intrinsics, 3x4 world->cam)
+  <root>/<seq>/<ts>.png|jpg   extracted frames named by timestamp
+
+Pairing:
+  * training: for each frame, a target sampled within +-max_frame_gap frames
+    of the source (testing.frames_apart: "random", or an int for a fixed
+    offset) — the video-sequence analog of LLFF's same-scene target pick.
+  * validation: the reference's released protocol — one JSONL line per pair
+    with src_img_obj and tgt_img_obj_{5,10}_frames / tgt_img_obj_random
+    entries carrying (sequence_id, frame_ts, camera_intrinsics 4-vector,
+    camera_pose 3x4). `tgt_key` picks the protocol column.
+
+Sparse 3D points: the public dataset carries none (the reference's internal
+pipeline evidently had them — visible_point_count: 256 in its realestate
+config). Two supported modes:
+  * points_root/<seq>.npz with key "xyz" [N,3] world-frame points (e.g. from
+    an offline SfM pass) -> per-view camera-frame visible subsets, exactly
+    like the LLFF loader.
+  * data.visible_point_count: 0 -> dummy points; mpi_config_from_dict then
+    disables the sparse-disparity loss and scale factor (documented
+    TPU-native config extension).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+from PIL import Image as PILImage
+
+_FRAME_EXTS = (".png", ".jpg", ".jpeg")
+
+
+def parse_camera_file(path: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """Parse one RealEstate10K camera txt -> {ts: {intrinsics[4], pose[3,4]}}.
+
+    Lines: timestamp fx fy cx cy k1 k2 p11..p34 (19 floats); intrinsics are
+    resolution-normalized; pose is world->camera [R|t] row-major.
+    """
+    out = {}
+    with open(path) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    for ln in lines:
+        parts = ln.split()
+        if len(parts) < 19:
+            continue  # the URL header line (or malformed)
+        try:
+            vals = [float(x) for x in parts]
+        except ValueError:
+            continue
+        ts = parts[0]
+        out[ts] = {
+            "intrinsics": np.asarray(vals[1:5], np.float32),
+            "pose": np.asarray(vals[7:19], np.float32).reshape(3, 4),
+        }
+    return out
+
+
+def _g_cam_world(pose_34: np.ndarray) -> np.ndarray:
+    G = np.eye(4, dtype=np.float32)
+    G[:3, :4] = pose_34
+    return G
+
+
+def _intrinsics_matrix(norm_k: np.ndarray, w: int, h: int) -> np.ndarray:
+    fx, fy, cx, cy = [float(v) for v in norm_k]
+    return np.asarray([[fx * w, 0.0, cx * w],
+                       [0.0, fy * h, cy * h],
+                       [0.0, 0.0, 1.0]], np.float32)
+
+
+class RealEstate10KDataset:
+    def __init__(self,
+                 root: str,
+                 is_validation: bool,
+                 img_size: Tuple[int, int],
+                 visible_points_count: int = 0,
+                 frames_apart="random",
+                 max_frame_gap: int = 30,
+                 pairs_json: Optional[str] = None,
+                 tgt_key: str = "tgt_img_obj_5_frames",
+                 points_root: Optional[str] = None,
+                 cache_frames: int = 4096,
+                 logger=None):
+        self.img_w, self.img_h = img_size
+        self.is_validation = is_validation
+        self.visible_points_count = int(visible_points_count)
+        self.frames_apart = frames_apart
+        self.max_frame_gap = int(max_frame_gap)
+        self.tgt_key = tgt_key
+        # decoded-frame LRU — frames decode lazily (the full RE10K split is
+        # hundreds of GB decoded; eager RAM caching like the LLFF loader is
+        # only viable for its ~8-scene datasets)
+        self._img_cache: "collections.OrderedDict[str, np.ndarray]" = \
+            collections.OrderedDict()
+        self._cache_frames = int(cache_frames)
+
+        if self.visible_points_count > 0 and points_root is None:
+            raise ValueError(
+                "RealEstate10K ships no sparse 3D points: either supply "
+                "points_root (<seq>.npz with world-frame 'xyz' [N,3]) or set "
+                "data.visible_point_count: 0 (disables the sparse-disparity "
+                "loss and scale factor)")
+
+        # ---- scan sequences: cameras + frame PATHS only (lazy decode) ----
+        self.frames: Dict[Tuple[str, str], Dict] = {}   # (seq, ts) -> info
+        self.seq_ts: Dict[str, list] = {}               # ordered ts per seq
+        self.points: Dict[str, np.ndarray] = {}
+
+        for entry in sorted(os.listdir(root)):
+            if not entry.endswith(".txt"):
+                continue
+            seq = entry[:-4]
+            frame_dir = os.path.join(root, seq)
+            if not os.path.isdir(frame_dir):
+                continue
+            cams = parse_camera_file(os.path.join(root, entry))
+            ts_list = []
+            for ts in sorted(cams, key=lambda t: int(t)):
+                img_path = None
+                for ext in _FRAME_EXTS:
+                    cand = os.path.join(frame_dir, ts + ext)
+                    if os.path.exists(cand):
+                        img_path = cand
+                        break
+                if img_path is None:
+                    continue
+                self.frames[(seq, ts)] = {
+                    "img_path": img_path,
+                    "G_cam_world": _g_cam_world(cams[ts]["pose"]),
+                    "K": _intrinsics_matrix(cams[ts]["intrinsics"],
+                                            self.img_w, self.img_h),
+                }
+                ts_list.append(ts)
+            if len(ts_list) >= 2:
+                self.seq_ts[seq] = ts_list
+            if points_root is not None:
+                ppath = os.path.join(points_root, seq + ".npz")
+                if os.path.exists(ppath):
+                    self.points[seq] = np.load(ppath)["xyz"].astype(np.float32)
+
+        # ---- item index ----
+        if is_validation and pairs_json:
+            self.pairs = self._load_pairs_json(pairs_json)
+        else:
+            # one item per cached frame with >=1 in-gap neighbor
+            self.items = [(seq, i) for seq, tss in sorted(self.seq_ts.items())
+                          for i in range(len(tss))]
+
+        if logger is not None:
+            n = len(self.pairs) if (is_validation and pairs_json) \
+                else len(self.items)
+            logger.info("RealEstate10K %s: %d sequences, %d items",
+                        "val" if is_validation else "train",
+                        len(self.seq_ts), n)
+
+    # ---------------- eval-protocol pairs ----------------
+
+    def _load_pairs_json(self, path: str) -> List[Tuple[Dict, Dict]]:
+        """Parse the reference's validation_pairs.json protocol (JSONL); keep
+        pairs whose frames exist in the local extraction."""
+        pairs = []
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rec = json.loads(ln)
+                src, tgt = rec["src_img_obj"], rec[self.tgt_key]
+                ks = (src["sequence_id"], str(src["frame_ts"]))
+                kt = (tgt["sequence_id"], str(tgt["frame_ts"]))
+                if ks in self.frames and kt in self.frames:
+                    pairs.append((self._protocol_info(src),
+                                  self._protocol_info(tgt)))
+        return pairs
+
+    def _protocol_info(self, obj: Dict) -> Dict:
+        """Frame info with the protocol's own camera (the JSON carries pose +
+        intrinsics; images come from the local extraction). Keeps the lazy
+        img_path; get_item decodes."""
+        key = (obj["sequence_id"], str(obj["frame_ts"]))
+        info = dict(self.frames[key])
+        info["seq"] = obj["sequence_id"]
+        info["G_cam_world"] = _g_cam_world(
+            np.asarray(obj["camera_pose"], np.float32).reshape(3, 4))
+        info["K"] = _intrinsics_matrix(
+            np.asarray(obj["camera_intrinsics"], np.float32),
+            self.img_w, self.img_h)
+        return info
+
+    # ---------------- item sampling ----------------
+
+    def __len__(self) -> int:
+        if self.is_validation and hasattr(self, "pairs"):
+            return len(self.pairs)
+        return len(self.items)
+
+    def _decode(self, path: str) -> np.ndarray:
+        img = self._img_cache.get(path)
+        if img is not None:
+            self._img_cache.move_to_end(path)
+            return img
+        pil = PILImage.open(path).convert("RGB")
+        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
+        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        self._img_cache[path] = img
+        while len(self._img_cache) > self._cache_frames:
+            self._img_cache.popitem(last=False)
+        return img
+
+    def _info(self, seq: str, ts: str) -> Dict:
+        info = dict(self.frames[(seq, ts)])
+        info["seq"] = seq
+        info["img"] = self._decode(info.pop("img_path"))
+        return info
+
+    def get_item(self, index: int, rng: np.random.RandomState):
+        if self.is_validation and hasattr(self, "pairs"):
+            src, tgt = (dict(d) for d in self.pairs[index])
+            src["img"] = self._decode(src.pop("img_path"))
+            tgt["img"] = self._decode(tgt.pop("img_path"))
+        else:
+            seq, i = self.items[index]
+            tss = self.seq_ts[seq]
+            if isinstance(self.frames_apart, int) \
+                    or str(self.frames_apart).lstrip("-").isdigit():
+                # fixed offset; when it runs off the sequence end, step
+                # BACKWARD by the same gap (never wrap to frame 0 — that
+                # would pair across the whole video)
+                off = int(self.frames_apart)
+                j = i + off
+                if not 0 <= j < len(tss):
+                    j = i - off
+                j = min(max(j, 0), len(tss) - 1)
+                if j == i:  # degenerate short sequence
+                    j = i + 1 if i + 1 < len(tss) else i - 1
+            else:
+                lo = max(0, i - self.max_frame_gap)
+                hi = min(len(tss) - 1, i + self.max_frame_gap)
+                j = i
+                while j == i:
+                    j = rng.randint(lo, hi + 1)
+            src = self._info(seq, tss[i])
+            tgt = self._info(seq, tss[j])
+        tgt = dict(tgt)
+        tgt["G_src_tgt"] = (
+            src["G_cam_world"]
+            @ np.linalg.inv(tgt["G_cam_world"])).astype(np.float32)
+        src = self._attach_points(src, rng)
+        tgt = self._attach_points(tgt, rng)
+        return src, tgt
+
+    def _attach_points(self, info: Dict, rng: np.random.RandomState) -> Dict:
+        n_want = self.visible_points_count
+        if n_want <= 0:
+            # dummy (unused: visible_point_count==0 disables the losses);
+            # z=1 keeps any accidental 1/z finite
+            info["xyzs"] = np.ones((3, 1), np.float32)
+            return info
+        pts = self.points.get(info["seq"])
+        if pts is None or len(pts) == 0:
+            raise ValueError(
+                f"no sparse points for sequence {info['seq']} "
+                f"(points_root npz missing)")
+        G = info["G_cam_world"]
+        cam = (G[:3, :3] @ pts.T + G[:3, 3:4]).astype(np.float32)  # [3,N]
+        pix = info["K"] @ cam
+        with np.errstate(divide="ignore", invalid="ignore"):
+            uv = pix[:2] / pix[2:3]
+        vis = (cam[2] > 1e-3) \
+            & (uv[0] >= 0) & (uv[0] < self.img_w) \
+            & (uv[1] >= 0) & (uv[1] < self.img_h)
+        cam = cam[:, vis]
+        if cam.shape[1] == 0:
+            raise ValueError(f"no visible points for sequence {info['seq']}")
+        sel = rng.choice(cam.shape[1], size=n_want,
+                         replace=cam.shape[1] < n_want)
+        info["xyzs"] = cam[:, sel]
+        return info
+
+    # ---------------- batching (LLFF contract) ----------------
+
+    def batch_iterator(self,
+                       batch_size: int,
+                       shuffle: bool,
+                       seed: int = 0,
+                       epoch: int = 0,
+                       drop_last: bool = True,
+                       shard_index: int = 0,
+                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        from mine_tpu.data.common import iterate_pair_batches
+        yield from iterate_pair_batches(
+            len(self), self.get_item, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)

@@ -164,3 +164,48 @@ def make_batch(batch_size: int = 1, height: int = 64, width: int = 64,
                              num_views=batch_size + 1, num_points=num_points)
     pairs = [(v, v + 1) for v in range(batch_size)]
     return ds.pair_batch(pairs)
+
+
+class SyntheticPairDataset:
+    """SyntheticMPIDataset behind the LLFFDataset batch_iterator contract.
+
+    Lets every consumer of get_dataset (train_cli, eval_cli, TrainLoop) run
+    without real data: `data.name: synthetic` in the config. Consecutive-view
+    pairs play the role of (src, tgt) items; the geometry/points are exact,
+    so losses and PSNR/SSIM behave like a real (tiny) scene.
+    """
+
+    def __init__(self, num_views: int = 6, num_points: int = 32,
+                 height: int = 64, width: int = 64, seed: int = 0):
+        self.ds = SyntheticMPIDataset(seed=seed, height=height, width=width,
+                                      num_views=num_views,
+                                      num_points=num_points)
+        self.pairs = [(i, i + 1) for i in range(num_views - 1)]
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def _view_info(self, v: int) -> Dict:
+        return {
+            "img": self.ds.images[v].transpose(1, 2, 0),  # HWC
+            "K": self.ds.K,
+            "G_cam_world": self.ds.G_cam_world[v],
+            "xyzs": self.ds.pt3d[v],
+        }
+
+    def get_pair(self, index: int, rng=None):
+        i, j = self.pairs[index]
+        src = self._view_info(i)
+        tgt = self._view_info(j)
+        tgt["G_src_tgt"] = (
+            src["G_cam_world"]
+            @ np.linalg.inv(tgt["G_cam_world"])).astype(np.float32)
+        return src, tgt
+
+    def batch_iterator(self, batch_size, shuffle, seed=0, epoch=0,
+                       drop_last=True, shard_index=0, num_shards=1):
+        from mine_tpu.data.common import iterate_pair_batches
+        yield from iterate_pair_batches(
+            len(self.pairs), self.get_pair, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)

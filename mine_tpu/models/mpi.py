@@ -6,7 +6,7 @@ Flax module so the whole forward lives in one XLA graph.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -22,6 +22,7 @@ class MPIPredictor(nn.Module):
     scales: Sequence[int] = (0, 1, 2, 3)
     sigma_dropout_rate: float = 0.0
     dtype: Optional[jnp.dtype] = None
+    mesh: Optional[Any] = None  # forwarded to the decoder's B*S sharding
 
     def setup(self):
         self.backbone = ResnetEncoder(num_layers=self.num_layers,
@@ -33,6 +34,7 @@ class MPIPredictor(nn.Module):
             scales=tuple(self.scales),
             sigma_dropout_rate=self.sigma_dropout_rate,
             dtype=self.dtype,
+            mesh=self.mesh,
             name="decoder")
 
     def __call__(self, src_imgs, disparity, train: bool):

@@ -127,7 +127,8 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          is_bg_depth_inf: bool = False,
                          backend: str = "xla",
                          warp_impl: str = "xla",
-                         warp_band: int = 16) -> TgtRender:
+                         warp_band: int = 16,
+                         mesh=None) -> TgtRender:
     """Render the MPI into a target camera.
 
     Concatenates [rgb, sigma, xyz_tgt] into a 7-channel plane volume, warps all
@@ -140,6 +141,10 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
       mpi_rgb_src: [B,S,3,H,W]; mpi_sigma_src: [B,S,1,H,W]
       mpi_disparity_src: [B,S]; xyz_tgt_BS3HW: [B,S,3,H,W]
       G_tgt_src: [B,4,4]; K_src_inv, K_tgt: [B,3,3]
+      mesh: ("data","plane") Mesh — on multi-device meshes the Pallas
+        backends run under shard_map (warp: B*S split over data*plane;
+        composite: batch over "data" with the plane axis gathered locally,
+        since the transparency chain reduces over S).
     """
     B, S, _, H, W = mpi_rgb_src.shape
     mpi_depth_src = 1.0 / mpi_disparity_src  # [B,S]
@@ -160,12 +165,20 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         grid,
         impl=warp_impl,
         band=warp_band,
+        mesh=mesh,
     )
 
     warped = warped.reshape(B, S, 7, H, W)
     tgt_rgb = warped[:, :, 0:3]
     tgt_sigma = warped[:, :, 3:4]
     tgt_xyz = warped[:, :, 4:7]
+
+    if mesh is not None and mesh.size > 1 \
+            and B % mesh.shape.get("data", 1) != 0:
+        # non-divisible batch (e.g. a remainder eval example): a bare
+        # pallas_call inside a GSPMD program carries no partitioning spec,
+        # so use the XLA composite instead of shard_map
+        backend = "xla"
 
     if backend in ("pallas", "pallas_diff") and not use_alpha:
         # fused composite: z-masking + volume rendering in one HBM pass
@@ -175,13 +188,28 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         interp = not on_tpu_backend()
         if backend == "pallas_diff":
             from mine_tpu.kernels.composite_vjp import fused_volume_render_diff
-            rgb_syn, depth_syn = fused_volume_render_diff(
-                tgt_rgb, tgt_sigma, tgt_xyz, True, is_bg_depth_inf, interp)
+            fn = lambda r, s, x: fused_volume_render_diff(  # noqa: E731
+                r, s, x, True, is_bg_depth_inf, interp)
         else:
             from mine_tpu.kernels.composite import fused_volume_render
-            rgb_syn, depth_syn = fused_volume_render(
-                tgt_rgb, tgt_sigma, tgt_xyz, z_mask=True,
+            fn = lambda r, s, x: fused_volume_render(  # noqa: E731
+                r, s, x, z_mask=True,
                 is_bg_depth_inf=is_bg_depth_inf, interpret=interp)
+        if mesh is not None and mesh.size > 1:
+            # batch over "data"; the plane axis is gathered to each device
+            # (the transparency cumprod chains over S — a distributed scan
+            # over "plane" is possible but the all-gather of the 7ch volume
+            # matches what GSPMD inserts for the XLA composite anyway)
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from mine_tpu.parallel.mesh import DATA_AXIS
+            # check_vma off: pallas_call outputs carry no mesh-variance info
+            fn = shard_map(fn, mesh=mesh,
+                           in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                           out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+                           check_vma=False)
+        rgb_syn, depth_syn = fn(tgt_rgb, tgt_sigma, tgt_xyz)
     else:
         tgt_z = tgt_xyz[:, :, 2:3]
         tgt_sigma = jnp.where(tgt_z >= 0.0, tgt_sigma, 0.0)

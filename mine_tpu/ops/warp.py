@@ -77,7 +77,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     K_tgt: jnp.ndarray,
                     meshgrid_tgt: jnp.ndarray,
                     impl: str = "xla",
-                    band: int = 16) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    band: int = 16,
+                    mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Warp source-plane images into the target camera via inverse homography.
 
     For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
@@ -98,6 +99,11 @@ def homography_warp(src_BCHW: jnp.ndarray,
         forward-only; caller must validate the band via
         kernels.warp.band_span), or "pallas_diff" (banded fwd+bwd kernels
         with a built-in runtime gather fallback — the training backend)
+      mesh: ("data","plane") jax Mesh. With impl="pallas_diff" on a
+        multi-device mesh the kernel runs under shard_map with the flat
+        B' axis split over data*plane (matching the decoder's B*S layout,
+        models/decoder.py shard_bs) — each device warps its local planes,
+        no cross-device traffic.
     Returns:
       tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
     """
@@ -123,11 +129,36 @@ def homography_warp(src_BCHW: jnp.ndarray,
         # outside the band domain (kernels/warp_vjp.py). Coords are
         # non-learnable (no-grad inverse above), so stop_gradient keeps the
         # two branches' autodiff structurally identical.
+        import functools
+
         from mine_tpu.kernels import on_tpu_backend
         from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
-        tgt = bilinear_sample_diff_guarded(
-            src_BCHW, jax.lax.stop_gradient(x), jax.lax.stop_gradient(y),
-            band=band, oband=band, interpret=not on_tpu_backend())
+        fn = functools.partial(bilinear_sample_diff_guarded,
+                               band=band, oband=band,
+                               interpret=not on_tpu_backend())
+        xs = jax.lax.stop_gradient(x)
+        ys = jax.lax.stop_gradient(y)
+        if mesh is not None and mesh.size > 1:
+            if Bp % mesh.size == 0:
+                # split the flat B' (=B*S, B-major) axis over data*plane:
+                # lines up with the decoder's shard_bs layout, so the volume
+                # is already local — the per-device kernel sees only its
+                # planes (and the band-domain cond decides per shard)
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
+                bs_axes = (DATA_AXIS, PLANE_AXIS)
+                # check_vma off: pallas outputs carry no mesh-variance info
+                fn = shard_map(fn, mesh=mesh,
+                               in_specs=(P(bs_axes), P(bs_axes), P(bs_axes)),
+                               out_specs=P(bs_axes), check_vma=False)
+            else:
+                # a bare pallas_call inside a GSPMD-partitioned program has
+                # no partitioning spec — fall back to the autodiffed gather
+                # for non-divisible batches (e.g. remainder eval examples)
+                fn = bilinear_sample
+        tgt = fn(src_BCHW, xs, ys)
     else:
         tgt = bilinear_sample(src_BCHW, x, y)
     return tgt, valid

@@ -159,7 +159,8 @@ def loss_per_scale(scale: int,
         K_src_inv, K_tgt,
         use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
         backend=cfg.composite_backend,
-        warp_impl=cfg.warp_backend, warp_band=cfg.warp_band)
+        warp_impl=cfg.warp_backend, warp_band=cfg.warp_band,
+        mesh=mesh if (mesh is not None and mesh.size > 1) else None)
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = _safe_reciprocal_depth(res.depth)
 
@@ -206,9 +207,15 @@ def loss_per_scale(scale: int,
         loss_smooth_tgt_v2 = zero
 
     psnr_tgt = jax.lax.stop_gradient(psnr(tgt_syn, tgt_imgs))
-    if is_val and scale == 0 and lpips_params is not None:
-        lpips_tgt = jnp.mean(lpips_mod.lpips_distance(
-            lpips_params, tgt_syn, tgt_imgs))
+    if is_val and scale == 0:
+        if lpips_params is not None:
+            lpips_tgt = jnp.mean(lpips_mod.lpips_distance(
+                lpips_params, tgt_syn, tgt_imgs))
+        else:
+            # absent weights must NOT read as a perfect 0.0 score — report
+            # NaN so downstream consumers can't mistake it for a measurement
+            # (losses/lpips.py module contract; VERDICT r1 weak item 5)
+            lpips_tgt = jnp.full((), jnp.nan, jnp.float32)
     else:
         lpips_tgt = zero
 

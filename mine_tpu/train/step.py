@@ -67,16 +67,9 @@ class SynthesisTrainer:
         self.mesh = mesh
         self.steps_per_epoch = steps_per_epoch
 
-        if mesh is not None and mesh.size > 1 \
-                and (self.cfg.composite_backend != "xla"
-                     or self.cfg.warp_backend != "xla"):
-            # the Pallas kernels carry no SPMD partitioning spec yet
-            # (neither batch nor plane axis) — multi-device meshes must use
-            # the XLA paths (ROADMAP: shard_map wrapper)
-            raise ValueError(
-                "training.composite_backend/warp_backend=pallas_diff "
-                "requires a single-device run; use the XLA paths on "
-                "multi-device meshes")
+        # Pallas backends compose with multi-device meshes via shard_map
+        # (ops/rendering.py, ops/warp.py): warp splits B*S over data*plane,
+        # composite batches over "data" with the plane axis gathered.
 
         dtype_name = config.get("training.dtype", "bfloat16")
         dtype = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
@@ -85,7 +78,8 @@ class SynthesisTrainer:
             pos_encoding_multires=self.cfg.pos_encoding_multires,
             use_alpha=self.cfg.use_alpha,
             sigma_dropout_rate=self.cfg.sigma_dropout_rate,
-            dtype=dtype)
+            dtype=dtype,
+            mesh=mesh if (mesh is not None and mesh.size > 1) else None)
         self.remat = bool(config.get("training.remat", False))
         self.tx = make_optimizer(config, steps_per_epoch)
         self.lpips_params = lpips_params
@@ -100,9 +94,13 @@ class SynthesisTrainer:
             self._eval_step = jax.jit(self._eval_step_impl,
                                       in_shardings=(repl, batch_s, repl),
                                       out_shardings=repl)
+            # unsharded variant for val-set remainder examples (any batch
+            # size, replicated) — run_eval pads nothing and drops nothing
+            self._eval_step_tail = jax.jit(self._eval_step_impl)
         else:
             self._train_step = jax.jit(self._train_step_impl, donate_argnums=0)
             self._eval_step = jax.jit(self._eval_step_impl)
+            self._eval_step_tail = self._eval_step
 
     # ---------------- batch geometry ----------------
 
@@ -228,3 +226,7 @@ class SynthesisTrainer:
 
     def eval_step(self, state: TrainState, batch, eval_key):
         return self._eval_step(state, batch, eval_key)
+
+    def eval_step_tail(self, state: TrainState, batch, eval_key):
+        """Eval for remainder batches whose size can't shard over the mesh."""
+        return self._eval_step_tail(state, batch, eval_key)

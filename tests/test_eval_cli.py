@@ -1,0 +1,99 @@
+"""End-to-end parity-eval pipeline (VERDICT r1 item 6): a checkpoint in the
+reference's release format (.pth {"backbone","decoder"} with DDP prefixes and
+the ModuleDict key quirk) -> tools/convert_torch_weights.py mine -> eval_cli
+on the synthetic scene -> one metrics JSON line with honest missing-metric
+handling (no LPIPS weights => key omitted + listed, never 0.0)."""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tools")
+from convert_torch_weights import main as convert_main  # noqa: E402
+
+from tests.test_convert import fake_mine_decoder_sd, fake_resnet18_sd
+
+
+def _reference_format_checkpoint(path):
+    """torch.save a MINE release-shaped checkpoint (synthesis_task.py:629-631
+    {"backbone","decoder"}, DDP 'module.' prefixes, backbone nesting the
+    torchvision net under 'encoder.' per resnet_encoder.py:81-83)."""
+    import torch
+
+    def torchify(sd):
+        # tame the random weights so the eval renders stay in a sane range
+        # (a raw N(0,1) BN state drives sigma to inf and the scale-factor
+        # log-ratio to NaN — a degenerate-checkpoint artifact, not a
+        # pipeline property)
+        out = {}
+        for k, v in sd.items():
+            if k.endswith("running_var"):
+                v = np.abs(v) * 0.1 + 1.0
+            elif k.endswith("running_mean"):
+                v = v * 0.1
+            elif k.endswith(("bn1.weight", "bn2.weight", "bn3.weight")) \
+                    or ".1.weight" in k or k.endswith(".bn.weight") \
+                    or "downsample.1.weight" in k:
+                v = 1.0 + 0.1 * v  # BN scale near 1
+            elif k.endswith("bias"):
+                v = v * 0.1
+            else:
+                v = v * 0.2  # conv kernels
+            out[k] = torch.from_numpy(np.ascontiguousarray(
+                np.asarray(v, np.float32)))
+        return out
+
+    ckpt = {
+        "backbone": {("module.encoder." + k): v
+                     for k, v in torchify(fake_resnet18_sd()).items()},
+        "decoder": {("module." + k): v
+                    for k, v in torchify(fake_mine_decoder_sd()).items()},
+        "optimizer": {},  # present in real checkpoints; must be ignored
+    }
+    torch.save(ckpt, path)
+
+
+@pytest.mark.slow
+def test_convert_then_eval_cli_end_to_end(tmp_path, monkeypatch):
+    pth = str(tmp_path / "checkpoint_latest.pth")
+    npz = str(tmp_path / "converted.npz")
+    _reference_format_checkpoint(pth)
+
+    convert_main(["mine", "--src", pth, "--out", npz])
+    assert os.path.exists(npz)
+
+    import eval_cli
+
+    extra = json.dumps({
+        "data.name": "synthetic",
+        "data.img_h": 64, "data.img_w": 64,
+        "data.num_seq_per_gpu": 1,          # 3 views -> 2 val pairs
+        "data.per_gpu_batch_size": 1,
+        "data.visible_point_count": 16,
+        "mpi.num_bins_coarse": 4,
+        "mpi.disparity_start": 1.0, "mpi.disparity_end": 0.2,
+        "model.num_layers": 18,
+        "training.dtype": "float32",
+    })
+    argv = ["eval_cli.py", "--checkpoint_path", npz,
+            "--config_path",
+            os.path.join("mine_tpu", "configs", "params_default.yaml"),
+            "--extra_config", extra]
+    # eval_cli re-asserts JAX_PLATFORMS from the env; the container exports
+    # JAX_PLATFORMS=axon (the tunneled TPU) — pin cpu for the test
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(sys, "argv", argv)
+    stdout = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", stdout)
+    eval_cli.main()
+
+    line = stdout.getvalue().strip().splitlines()[-1]
+    metrics = json.loads(line)  # honest JSON: must parse (no NaN tokens)
+    assert np.isfinite(metrics["psnr_tgt"])
+    assert np.isfinite(metrics["loss_rgb_tgt"])
+    assert "lpips_tgt" not in metrics
+    assert metrics["missing_metrics"] == ["lpips_tgt"]

@@ -1,0 +1,162 @@
+"""RealEstate10K loader against a synthetic on-disk fixture: camera-txt
+parsing, train pairing, the released validation_pairs.json protocol, sparse
+points, and the get_dataset dispatch (VERDICT r1 item 9 — capability beyond
+the reference, which raises NotImplementedError for non-LLFF,
+train.py:100-101)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mine_tpu.config import CONFIG_DIR, load_config, mpi_config_from_dict
+from mine_tpu.data.realestate10k import (RealEstate10KDataset,
+                                         parse_camera_file)
+
+W, H = 64, 48
+
+
+def _pose_line(ts, tx, ty, tz):
+    # identity rotation + translation, row-major 3x4 world->cam
+    pose = [1, 0, 0, tx, 0, 1, 0, ty, 0, 0, 1, tz]
+    vals = [ts, 0.5, 0.6, 0.5, 0.5, 0.0, 0.0] + pose
+    return " ".join(str(v) for v in vals)
+
+
+def _make_fixture(root, seqs=("aaa111", "bbb222"), n_frames=6):
+    rng = np.random.RandomState(0)
+    os.makedirs(root, exist_ok=True)
+    for k, seq in enumerate(seqs):
+        lines = ["https://example.invalid/watch?v=" + seq]
+        os.makedirs(os.path.join(root, seq), exist_ok=True)
+        for i in range(n_frames):
+            ts = str(1000 * (i + 1))
+            lines.append(_pose_line(ts, 0.05 * i, -0.02 * i, 0.01 * i + k))
+            img = (rng.uniform(size=(H, W, 3)) * 255).astype(np.uint8)
+            Image.fromarray(img).save(os.path.join(root, seq, ts + ".png"))
+        with open(os.path.join(root, seq + ".txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return [str(1000 * (i + 1)) for i in range(n_frames)]
+
+
+def test_parse_camera_file(tmp_path):
+    ts_list = _make_fixture(str(tmp_path))
+    cams = parse_camera_file(str(tmp_path / "aaa111.txt"))
+    assert sorted(cams, key=int) == ts_list
+    c = cams["2000"]
+    assert c["intrinsics"].shape == (4,)
+    assert c["pose"].shape == (3, 4)
+    np.testing.assert_allclose(c["pose"][:, 3], [0.05, -0.02, 0.01])
+
+
+def test_train_pairing_and_batch_contract(tmp_path):
+    _make_fixture(str(tmp_path))
+    ds = RealEstate10KDataset(str(tmp_path), is_validation=False,
+                              img_size=(W, H), frames_apart=1)
+    assert len(ds) == 12  # 2 seqs x 6 frames
+    batches = list(ds.batch_iterator(batch_size=4, shuffle=True, seed=1,
+                                     drop_last=True))
+    assert len(batches) == 3
+    b = batches[0]
+    assert b["src_img"].shape == (4, H, W, 3)
+    assert b["tgt_img"].shape == (4, H, W, 3)
+    assert b["K_src"].shape == (4, 3, 3)
+    assert b["G_src_tgt"].shape == (4, 4, 4)
+    # intrinsics denormalized: fx = 0.5*W, cy = 0.5*H
+    np.testing.assert_allclose(b["K_src"][0, 0, 0], 0.5 * W)
+    np.testing.assert_allclose(b["K_src"][0, 1, 2], 0.5 * H)
+    # identity-rotation fixture: G_src_tgt translation = t_src - t_tgt
+    src_idx, rngs = 0, np.random.RandomState(0)
+    src, tgt = ds.get_item(2, rngs)  # seq aaa111 frame i=2, tgt i=3
+    expect = src["G_cam_world"] @ np.linalg.inv(tgt["G_cam_world"])
+    np.testing.assert_allclose(tgt["G_src_tgt"], expect, atol=1e-6)
+    np.testing.assert_allclose(tgt["G_src_tgt"][:3, 3],
+                               [-0.05, 0.02, -0.01], atol=1e-6)
+
+
+def test_validation_pairs_protocol(tmp_path):
+    ts_list = _make_fixture(str(tmp_path))
+    pairs_path = str(tmp_path / "validation_pairs.json")
+    with open(pairs_path, "w") as f:
+        for seq in ("aaa111", "bbb222"):
+            rec = {
+                "sequence_id": seq,
+                "src_img_obj": {
+                    "sequence_id": seq, "frame_ts": ts_list[0],
+                    "camera_intrinsics": [0.5, 0.6, 0.5, 0.5],
+                    "camera_pose": [1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0]},
+                "tgt_img_obj_5_frames": {
+                    "sequence_id": seq, "frame_ts": ts_list[2],
+                    "camera_intrinsics": [0.5, 0.6, 0.5, 0.5],
+                    "camera_pose": [1, 0, 0, 0.3, 0, 1, 0, 0, 0, 0, 1, 0]},
+            }
+            f.write(json.dumps(rec) + "\n")
+        # a pair whose frames are not in the local extraction: skipped
+        f.write(json.dumps({
+            "sequence_id": "zzz",
+            "src_img_obj": {"sequence_id": "zzz", "frame_ts": "1",
+                            "camera_intrinsics": [0.5, 0.6, 0.5, 0.5],
+                            "camera_pose": [1, 0, 0, 0] * 3},
+            "tgt_img_obj_5_frames": {"sequence_id": "zzz", "frame_ts": "2",
+                                     "camera_intrinsics": [0.5, 0.6, 0.5, 0.5],
+                                     "camera_pose": [1, 0, 0, 0] * 3},
+        }) + "\n")
+
+    ds = RealEstate10KDataset(str(tmp_path), is_validation=True,
+                              img_size=(W, H), pairs_json=pairs_path)
+    assert len(ds) == 2
+    b = next(ds.batch_iterator(batch_size=2, shuffle=False, drop_last=False))
+    # protocol pose wins: pure -0.3 x-shift src<-tgt
+    np.testing.assert_allclose(b["G_src_tgt"][0, :3, 3], [-0.3, 0, 0],
+                               atol=1e-6)
+
+
+def test_sparse_points_mode(tmp_path):
+    _make_fixture(str(tmp_path))
+    pts_dir = str(tmp_path / "pts")
+    os.makedirs(pts_dir)
+    rng = np.random.RandomState(3)
+    for seq in ("aaa111", "bbb222"):
+        # world points in front of all cameras, inside the frustum
+        xyz = np.stack([rng.uniform(-0.2, 0.2, 64),
+                        rng.uniform(-0.15, 0.15, 64),
+                        rng.uniform(3.0, 6.0, 64)], axis=1)
+        np.savez(os.path.join(pts_dir, seq + ".npz"), xyz=xyz)
+
+    ds = RealEstate10KDataset(str(tmp_path), is_validation=False,
+                              img_size=(W, H), visible_points_count=8,
+                              frames_apart=1, points_root=pts_dir)
+    b = next(ds.batch_iterator(batch_size=2, shuffle=False))
+    assert b["pt3d_src"].shape == (2, 3, 8)
+    assert (b["pt3d_src"][:, 2] > 0).all()  # camera-frame, in front
+
+    with pytest.raises(ValueError, match="sparse 3D points"):
+        RealEstate10KDataset(str(tmp_path), is_validation=False,
+                             img_size=(W, H), visible_points_count=8)
+
+
+def test_get_dataset_dispatch_and_config(tmp_path):
+    from mine_tpu.data.llff import get_dataset
+
+    _make_fixture(str(tmp_path))
+    cfg = load_config(os.path.join(CONFIG_DIR, "params_realestate.yaml"))
+    cfg.update({
+        "data.training_set_path": str(tmp_path),
+        "data.val_set_path": str(tmp_path),
+        "data.img_w": W, "data.img_h": H,
+        "data.visible_point_count": 0,
+    })
+    train, val = get_dataset(cfg)
+    assert len(train) == 12
+    b = next(train.batch_iterator(batch_size=2, shuffle=False))
+    assert b["src_img"].shape == (2, H, W, 3)
+    assert b["pt3d_src"].shape == (2, 3, 1)  # dummy points
+
+    mc = mpi_config_from_dict(cfg)
+    assert not mc.use_disparity_loss and not mc.use_scale_factor
+    # with points available the reference behavior stands
+    cfg["data.visible_point_count"] = 256
+    mc = mpi_config_from_dict(cfg)
+    assert mc.use_disparity_loss and mc.use_scale_factor

@@ -1,0 +1,208 @@
+"""DTU MVS dataset — calibrated scan views as (src, tgt), rotation-limited.
+
+Capability beyond the reference's code: it ships a dtu config
+(configs/params_dtu.yaml, with `data.rotation_pi_ratio` and
+`data.is_exclude_views` that only this dataset uses, plus
+`mpi.is_bg_depth_inf: true`) but no loader (train.py:100-101 raises). This
+loader consumes the standard MVSNet-preprocessed DTU layout:
+
+  <root>/Cameras/<VVVVVVVV>_cam.txt       per-view calibration:
+                                            extrinsic\n<4x4 world->cam>
+                                            intrinsic\n<3x3>
+                                            <depth_min> <depth_interval>
+  <root>/Rectified/scanN_train/rect_<VVV>_<L>_r5000.png
+                                          view VVV (1-based), light L
+
+Pairing honors the dtu config keys: a target view qualifies when the
+relative rotation angle between its camera and the source's is at most
+pi / rotation_pi_ratio (the dataset is a hemisphere rig — unrestricted
+pairs have near-zero overlap), and `is_exclude_views` drops the standard
+MVS evaluation views from training. Training picks a random qualifying
+target and a random light; validation is deterministic.
+
+DTU's MPI mode: depth is composited against an infinite background
+(`mpi.is_bg_depth_inf`, weighted_sum_mpi, mpi_rendering.py:74-77) and the
+valid-mask threshold is 0. Sparse SfM points are not part of the MVSNet
+distribution: dtu is in the no-disparity-loss set (synthesis_task.py:
+213-214), so items carry dummy points.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+from PIL import Image as PILImage
+
+# the customary DTU evaluation view subset (MVS protocol) dropped when
+# data.is_exclude_views is set
+EVAL_VIEWS = (3, 13, 23, 33, 43)
+
+
+def parse_dtu_cam(path: str) -> Dict[str, np.ndarray]:
+    """MVSNet cam txt -> {extrinsic [4,4], intrinsic [3,3], depth [2]}."""
+    with open(path) as f:
+        tokens = f.read().split()
+    out = {}
+    i = 0
+    while i < len(tokens):
+        t = tokens[i].lower()
+        if t == "extrinsic":
+            out["extrinsic"] = np.asarray(
+                [float(x) for x in tokens[i + 1:i + 17]],
+                np.float32).reshape(4, 4)
+            i += 17
+        elif t == "intrinsic":
+            out["intrinsic"] = np.asarray(
+                [float(x) for x in tokens[i + 1:i + 10]],
+                np.float32).reshape(3, 3)
+            i += 10
+        else:
+            try:
+                out.setdefault("depth", []).append(float(t))
+            except ValueError:
+                pass
+            i += 1
+    if "depth" in out:
+        out["depth"] = np.asarray(out["depth"], np.float32)
+    return out
+
+
+def rotation_angle(R_a: np.ndarray, R_b: np.ndarray) -> float:
+    """Geodesic angle between two rotations (radians)."""
+    R = R_a @ R_b.T
+    c = np.clip((np.trace(R) - 1.0) / 2.0, -1.0, 1.0)
+    return float(np.arccos(c))
+
+
+class DTUDataset:
+    def __init__(self,
+                 root: str,
+                 is_validation: bool,
+                 img_size: Tuple[int, int],
+                 rotation_pi_ratio: float = 3.0,
+                 is_exclude_views: bool = False,
+                 intrinsics_scale: float = 4.0,
+                 logger=None):
+        self.img_w, self.img_h = img_size
+        self.is_validation = is_validation
+        self.max_angle = np.pi / float(rotation_pi_ratio)
+        # MVSNet cam files store intrinsics at quarter resolution (they
+        # match the 160x128 depth maps, not the 640x512 Rectified images);
+        # this factor maps cam-file pixels -> Rectified-image pixels
+        self.intrinsics_scale = float(intrinsics_scale)
+
+        # ---- calibrations (shared across scans) ----
+        # standard training distribution nests them in Cameras/train/
+        self.cams: Dict[int, Dict[str, np.ndarray]] = {}
+        cam_dir = os.path.join(root, "Cameras")
+        paths = sorted(glob.glob(os.path.join(cam_dir, "*_cam.txt"))) \
+            or sorted(glob.glob(os.path.join(cam_dir, "train", "*_cam.txt")))
+        for p in paths:
+            view = int(os.path.basename(p).split("_")[0])
+            cam = parse_dtu_cam(p)
+            if "extrinsic" in cam and "intrinsic" in cam:
+                self.cams[view] = cam
+        if not self.cams:
+            raise ValueError(
+                f"no camera files under {cam_dir} (or {cam_dir}/train)")
+
+        # ---- scan image index: scan -> view -> {light: path} ----
+        pat = re.compile(r"rect_(\d+)_(\w+)_r5000\.png$")
+        self.scans: Dict[str, Dict[int, Dict[str, str]]] = {}
+        for scan_dir in sorted(glob.glob(os.path.join(root, "Rectified",
+                                                      "scan*"))):
+            scan = os.path.basename(scan_dir)
+            views: Dict[int, Dict[str, str]] = {}
+            for img in sorted(glob.glob(os.path.join(scan_dir, "rect_*.png"))):
+                m = pat.search(os.path.basename(img))
+                if not m:
+                    continue
+                view = int(m.group(1)) - 1  # filenames are 1-based
+                if view not in self.cams:
+                    continue
+                if is_exclude_views and not is_validation \
+                        and view in EVAL_VIEWS:
+                    continue
+                views.setdefault(view, {})[m.group(2)] = img
+            if len(views) >= 2:
+                self.scans[scan] = views
+
+        # ---- qualifying (src, tgt) view pairs per the rotation limit ----
+        self.pair_views: Dict[int, List[int]] = {}
+        views_all = sorted(self.cams)
+        for a in views_all:
+            Ra = self.cams[a]["extrinsic"][:3, :3]
+            self.pair_views[a] = [
+                b for b in views_all if b != a
+                and rotation_angle(Ra, self.cams[b]["extrinsic"][:3, :3])
+                <= self.max_angle]
+
+        # flat item list: (scan, src_view) with >=1 qualifying target present
+        self.items: List[Tuple[str, int]] = []
+        for scan, views in sorted(self.scans.items()):
+            for v in sorted(views):
+                if any(t in views for t in self.pair_views.get(v, ())):
+                    self.items.append((scan, v))
+        if logger is not None:
+            logger.info(
+                "DTU %s: %d scans, %d items, rotation limit %.1f deg",
+                "val" if is_validation else "train", len(self.scans),
+                len(self.items), np.degrees(self.max_angle))
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    # ---------------- items ----------------
+
+    def _view_info(self, scan: str, view: int, light: str) -> Dict:
+        path = self.scans[scan][view][light]
+        pil = PILImage.open(path).convert("RGB")
+        w0, h0 = pil.size
+        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
+        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        K = self.cams[view]["intrinsic"] * self.intrinsics_scale
+        K[2, 2] = 1.0
+        K[0] *= self.img_w / w0
+        K[1] *= self.img_h / h0
+        return {"img": img, "K": K.astype(np.float32),
+                "G_cam_world": self.cams[view]["extrinsic"],
+                "xyzs": np.ones((3, 1), np.float32)}
+
+    def get_item(self, index: int, rng: np.random.RandomState):
+        scan, v_src = self.items[index]
+        views = self.scans[scan]
+        candidates = [t for t in self.pair_views[v_src] if t in views]
+        if self.is_validation:
+            v_tgt = candidates[index % len(candidates)]
+            light_s = sorted(views[v_src])[0]
+            light_t = light_s if light_s in views[v_tgt] \
+                else sorted(views[v_tgt])[0]
+        else:
+            v_tgt = candidates[rng.randint(len(candidates))]
+            light_s = sorted(views[v_src])[rng.randint(len(views[v_src]))]
+            light_t = light_s if light_s in views[v_tgt] \
+                else sorted(views[v_tgt])[0]
+        src = self._view_info(scan, v_src, light_s)
+        tgt = self._view_info(scan, v_tgt, light_t)
+        tgt["G_src_tgt"] = (
+            src["G_cam_world"]
+            @ np.linalg.inv(tgt["G_cam_world"])).astype(np.float32)
+        return src, tgt
+
+    def batch_iterator(self,
+                       batch_size: int,
+                       shuffle: bool,
+                       seed: int = 0,
+                       epoch: int = 0,
+                       drop_last: bool = True,
+                       shard_index: int = 0,
+                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        from mine_tpu.data.common import iterate_pair_batches
+        yield from iterate_pair_batches(
+            len(self), self.get_item, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)

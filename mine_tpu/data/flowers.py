@@ -1,0 +1,149 @@
+"""Flowers light-field dataset — lenslet sub-aperture views as (src, tgt).
+
+Capability beyond the reference's code: it ships the calibration and split
+assets for this dataset (input_pipelines/flowers/cam_params.txt — an 8x8
+camera grid keyed "r_c" with normalized intrinsics + [R|t] — and
+dataset_list/{train,test}.list of `imgs/*_eslf.png` paths) plus a flowers
+config (configs/params_flowers.yaml), but no loader (train.py:100-101
+raises). This loader consumes exactly those asset formats.
+
+The underlying data is the Stanford light-field flowers set: each
+`*_eslf.png` is a lenslet image in ESLF layout — sub-aperture view (u, v)
+is the pixel grid `eslf[u::S, v::S]` for lenslet stride S (14 for the real
+data); the calibrated views are the central GxG (G=8) of the SxS grid, so
+camera "r_c" maps to (u, v) = (r, c) + (S-G)//2.
+
+Items: src = the central calibrated view, tgt = a random other view of the
+same scene (deterministic for validation) — a light-field camera array is a
+dense novel-view rig, which is what MINE trains on here. Flowers carries no
+sparse SfM points; it is in the no-disparity-loss dataset set
+(synthesis_task.py:213-214), so items get dummy points.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+from PIL import Image as PILImage
+
+
+def parse_cam_params(path: str) -> Dict[Tuple[int, int], Dict[str, np.ndarray]]:
+    """cam_params.txt -> {(r, c): {intrinsics[4], pose[3,4]}}.
+
+    Line: `r_c fx fy cx cy k1 k2 r11 r12 r13 t1 r21 ... t3` (19 fields,
+    intrinsics normalized by resolution, pose world->camera row-major).
+    """
+    out = {}
+    with open(path) as f:
+        for ln in f:
+            parts = ln.split()
+            if len(parts) < 19:
+                continue
+            r, c = (int(x) for x in parts[0].split("_"))
+            vals = [float(x) for x in parts[1:]]
+            out[(r, c)] = {
+                "intrinsics": np.asarray(vals[0:4], np.float32),
+                "pose": np.asarray(vals[6:18], np.float32).reshape(3, 4),
+            }
+    return out
+
+
+def extract_subaperture(eslf: np.ndarray, u: int, v: int,
+                        stride: int) -> np.ndarray:
+    """ESLF lenslet image [H*S, W*S, 3] -> sub-aperture view (u, v) [H, W, 3]."""
+    return eslf[u::stride, v::stride]
+
+
+class FlowersDataset:
+    def __init__(self,
+                 root: str,
+                 is_validation: bool,
+                 img_size: Tuple[int, int],
+                 cam_params_path: Optional[str] = None,
+                 list_path: Optional[str] = None,
+                 grid: int = 8,
+                 lenslet_stride: int = 14,
+                 logger=None):
+        self.img_w, self.img_h = img_size
+        self.is_validation = is_validation
+        self.grid = int(grid)
+        self.stride = int(lenslet_stride)
+        self.offset = (self.stride - self.grid) // 2
+        self.root = root
+
+        cam_params_path = cam_params_path or os.path.join(root, "cam_params.txt")
+        if list_path is None:
+            list_path = os.path.join(
+                root, "dataset_list",
+                "test.list" if is_validation else "train.list")
+        self.cams = parse_cam_params(cam_params_path)
+        if not self.cams:
+            raise ValueError(f"no camera entries in {cam_params_path}")
+
+        with open(list_path) as f:
+            self.paths = [os.path.join(root, ln.strip())
+                          for ln in f if ln.strip()]
+        self.paths = [p for p in self.paths if os.path.exists(p)]
+        if logger is not None:
+            logger.info("Flowers %s: %d scenes, %dx%d view grid",
+                        "val" if is_validation else "train",
+                        len(self.paths), self.grid, self.grid)
+
+        self.center = (self.grid // 2, self.grid // 2)
+        self.others = [(r, c) for r in range(self.grid)
+                       for c in range(self.grid) if (r, c) != self.center
+                       and (r, c) in self.cams]
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    # ---------------- views ----------------
+
+    def _load_view(self, eslf: np.ndarray, rc: Tuple[int, int]) -> Dict:
+        """eslf: uint8 lenslet image (decoded once per item in get_item)."""
+        u, v = rc[0] + self.offset, rc[1] + self.offset
+        view = np.ascontiguousarray(
+            extract_subaperture(eslf, u, v, self.stride))
+        pil = PILImage.fromarray(view)
+        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
+        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+
+        cam = self.cams[rc]
+        fx, fy, cx, cy = (float(x) for x in cam["intrinsics"])
+        K = np.asarray([[fx * self.img_w, 0, cx * self.img_w],
+                        [0, fy * self.img_h, cy * self.img_h],
+                        [0, 0, 1]], np.float32)
+        G = np.eye(4, dtype=np.float32)
+        G[:3, :4] = cam["pose"]
+        return {"img": img, "K": K, "G_cam_world": G,
+                "xyzs": np.ones((3, 1), np.float32)}  # no SfM points
+
+    def get_item(self, index: int, rng: np.random.RandomState):
+        eslf = np.asarray(
+            PILImage.open(self.paths[index]).convert("RGB"))  # uint8
+        src = self._load_view(eslf, self.center)
+        if self.is_validation:
+            tgt_rc = self.others[index % len(self.others)]
+        else:
+            tgt_rc = self.others[rng.randint(len(self.others))]
+        tgt = self._load_view(eslf, tgt_rc)
+        tgt["G_src_tgt"] = (
+            src["G_cam_world"]
+            @ np.linalg.inv(tgt["G_cam_world"])).astype(np.float32)
+        return src, tgt
+
+    def batch_iterator(self,
+                       batch_size: int,
+                       shuffle: bool,
+                       seed: int = 0,
+                       epoch: int = 0,
+                       drop_last: bool = True,
+                       shard_index: int = 0,
+                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        from mine_tpu.data.common import iterate_pair_batches
+        yield from iterate_pair_batches(
+            len(self), self.get_item, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)

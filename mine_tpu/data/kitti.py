@@ -1,0 +1,148 @@
+"""KITTI raw dataset — rectified stereo pairs as (src, tgt).
+
+Capability beyond the reference's code: it ships a kitti_raw config
+(configs/params_kitti_raw.yaml, 384x128) but no loader (train.py:100-101
+raises). Following the single-image-MPI lineage MINE builds on, KITTI
+training pairs are the rectified stereo views: after rectification both
+cameras share the rotation and differ by a pure x-baseline, which the
+standard calib files give exactly — no SfM needed.
+
+On-disk layout (the public KITTI raw sync+rect distribution):
+  <root>/<date>/calib_cam_to_cam.txt        P_rect_02 / P_rect_03 (3x4)
+  <root>/<date>/<date>_drive_XXXX_sync/image_02/data/NNNNNNNNNN.png  (left)
+  <root>/<date>/<date>_drive_XXXX_sync/image_03/data/NNNNNNNNNN.png  (right)
+
+Geometry: P_rect_0i = K_rect [I | t_i] with t_i,x = P[0,3]/fx relative to
+the rectified cam-0 frame; the right-from-left transform is a pure
+translation of (t_3x - t_2x) (~ -0.54 m x-baseline, right camera sits at
+more negative rectified x). Training randomly swaps which eye is src so the
+model sees both directions; validation is deterministic left->right.
+
+kitti_raw is a no-SfM-points dataset (synthesis_task.py:213-214): items
+carry dummy points and the sparse-disparity loss / scale factor are off.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+from PIL import Image as PILImage
+
+
+def parse_calib_cam_to_cam(path: str) -> Dict[str, np.ndarray]:
+    """calib_cam_to_cam.txt -> {key: array} (P_rect_02/03 as [3,4],
+    S_rect_02 as [w, h])."""
+    out = {}
+    with open(path) as f:
+        for ln in f:
+            if ":" not in ln:
+                continue
+            key, val = ln.split(":", 1)
+            try:
+                arr = np.asarray([float(x) for x in val.split()], np.float32)
+            except ValueError:
+                continue
+            if key.startswith("P_rect"):
+                arr = arr.reshape(3, 4)
+            out[key.strip()] = arr
+    return out
+
+
+def stereo_geometry(calib: Dict[str, np.ndarray]):
+    """(K_rect [3,3] at native resolution, native [w,h], right-from-left
+    x-baseline in meters)."""
+    P2, P3 = calib["P_rect_02"], calib["P_rect_03"]
+    K = P2[:, :3].copy()
+    fx = P2[0, 0]
+    tx2, tx3 = P2[0, 3] / fx, P3[0, 3] / fx
+    size = calib.get("S_rect_02")
+    return K, size, float(tx3 - tx2)
+
+
+class KITTIRawDataset:
+    def __init__(self,
+                 root: str,
+                 is_validation: bool,
+                 img_size: Tuple[int, int],
+                 drives: Optional[List[str]] = None,
+                 logger=None):
+        self.img_w, self.img_h = img_size
+        self.is_validation = is_validation
+
+        # (left_path, right_path, K_scaled, baseline) per frame
+        self.items: List[Tuple[str, str, np.ndarray, float]] = []
+        for date_dir in sorted(glob.glob(os.path.join(root, "*"))):
+            calib_path = os.path.join(date_dir, "calib_cam_to_cam.txt")
+            if not os.path.isfile(calib_path):
+                continue
+            calib = parse_calib_cam_to_cam(calib_path)
+            if "P_rect_02" not in calib or "P_rect_03" not in calib:
+                continue
+            K_native, size, baseline = stereo_geometry(calib)
+            for drive in sorted(glob.glob(os.path.join(date_dir,
+                                                       "*_sync"))):
+                if drives and os.path.basename(drive) not in drives:
+                    continue
+                left_dir = os.path.join(drive, "image_02", "data")
+                right_dir = os.path.join(drive, "image_03", "data")
+                if not os.path.isdir(left_dir):
+                    continue
+                for lp in sorted(glob.glob(os.path.join(left_dir, "*.png"))):
+                    rp = os.path.join(right_dir, os.path.basename(lp))
+                    if not os.path.exists(rp):
+                        continue
+                    if size is not None:
+                        w0, h0 = float(size[0]), float(size[1])
+                    else:
+                        with PILImage.open(lp) as im:
+                            w0, h0 = im.size
+                    K = K_native.copy()
+                    K[0] *= self.img_w / w0
+                    K[1] *= self.img_h / h0
+                    self.items.append((lp, rp, K.astype(np.float32),
+                                       baseline))
+        if logger is not None:
+            logger.info("KITTI raw %s: %d stereo pairs",
+                        "val" if is_validation else "train", len(self.items))
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def _load(self, path: str) -> np.ndarray:
+        pil = PILImage.open(path).convert("RGB")
+        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
+        return np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+
+    def get_item(self, index: int, rng: np.random.RandomState):
+        lp, rp, K, baseline = self.items[index]
+        swap = (not self.is_validation) and bool(rng.randint(2))
+        src_p, tgt_p = (rp, lp) if swap else (lp, rp)
+        # src <- tgt transform: pure x-translation of the baseline (rectified
+        # frames share rotation). right-from-left = +baseline as src<-tgt
+        # when src is the left eye, negated when swapped.
+        t = -baseline if swap else baseline
+        G_src_tgt = np.eye(4, dtype=np.float32)
+        G_src_tgt[0, 3] = -t
+        src = {"img": self._load(src_p), "K": K,
+               "xyzs": np.ones((3, 1), np.float32)}
+        tgt = {"img": self._load(tgt_p), "K": K,
+               "G_src_tgt": G_src_tgt,
+               "xyzs": np.ones((3, 1), np.float32)}
+        return src, tgt
+
+    def batch_iterator(self,
+                       batch_size: int,
+                       shuffle: bool,
+                       seed: int = 0,
+                       epoch: int = 0,
+                       drop_last: bool = True,
+                       shard_index: int = 0,
+                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+        from mine_tpu.data.common import iterate_pair_batches
+        yield from iterate_pair_batches(
+            len(self), self.get_item, batch_size, shuffle, seed=seed,
+            epoch=epoch, drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards)

@@ -258,11 +258,53 @@ def get_dataset(config: Dict, logger=None) -> Tuple[LLFFDataset, LLFFDataset]:
             tgt_key=config.get("data.val_pairs_tgt", "tgt_img_obj_5_frames"),
             **common)
         return train, val
+    if name == "flowers":
+        # capability beyond the reference: consumes its shipped calibration
+        # assets (input_pipelines/flowers/) — see data/flowers.py
+        from mine_tpu.data.flowers import FlowersDataset
+        common = dict(
+            img_size=(config["data.img_w"], config["data.img_h"]),
+            cam_params_path=config.get("data.cam_params_path"),
+            grid=config.get("data.lenslet_grid", 8),
+            lenslet_stride=config.get("data.lenslet_stride", 14),
+            logger=logger)
+        train = FlowersDataset(root=config["data.training_set_path"],
+                               is_validation=False, **common)
+        val = FlowersDataset(root=config["data.val_set_path"],
+                             is_validation=True, **common)
+        return train, val
+    if name == "kitti_raw":
+        # capability beyond the reference: rectified stereo pairs from the
+        # public KITTI raw layout — see data/kitti.py
+        from mine_tpu.data.kitti import KITTIRawDataset
+        sz = (config["data.img_w"], config["data.img_h"])
+        train = KITTIRawDataset(root=config["data.training_set_path"],
+                                is_validation=False, img_size=sz,
+                                logger=logger)
+        val = KITTIRawDataset(root=config["data.val_set_path"],
+                              is_validation=True, img_size=sz, logger=logger)
+        return train, val
+    if name == "dtu":
+        # capability beyond the reference: MVSNet-preprocessed DTU layout,
+        # honoring its dtu-only config keys — see data/dtu.py
+        from mine_tpu.data.dtu import DTUDataset
+        common = dict(
+            img_size=(config["data.img_w"], config["data.img_h"]),
+            rotation_pi_ratio=float(config.get("data.rotation_pi_ratio", 3)),
+            is_exclude_views=bool(config.get("data.is_exclude_views", False)),
+            intrinsics_scale=float(
+                config.get("data.dtu_intrinsics_scale", 4) or 4),
+            logger=logger)
+        train = DTUDataset(root=config["data.training_set_path"],
+                           is_validation=False, **common)
+        val = DTUDataset(root=config["data.val_set_path"],
+                         is_validation=True, **common)
+        return train, val
     if name != "llff":
         raise NotImplementedError(
-            f"dataset '{name}': the reference ships only the LLFF/COLMAP "
-            f"loader (train.py:100-101); config parity for "
-            f"kitti_raw/flowers/dtu is provided, their loaders are not")
+            f"dataset '{name}': unknown dataset name (the reference itself "
+            f"ships only the LLFF loader, train.py:100-101; this framework "
+            f"adds realestate10k/kitti_raw/flowers/dtu/synthetic)")
     train = LLFFDataset(
         root=config["data.training_set_path"],
         is_validation=False,

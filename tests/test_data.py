@@ -147,8 +147,8 @@ def test_llff_validation_deterministic_targets(tmp_path):
     np.testing.assert_allclose(t1[0]["G_src_tgt"], t2[0]["G_src_tgt"])
 
 
-def test_get_dataset_rejects_unshipped_loaders():
-    # realestate10k gained a loader in round 2 (data/realestate10k.py);
-    # kitti_raw/flowers/dtu remain config-parity-only
+def test_get_dataset_rejects_unknown_names():
+    # every reference dataset config now has a loader (round 2); only truly
+    # unknown names are rejected
     with pytest.raises(NotImplementedError):
-        get_dataset({"data.name": "kitti_raw"})
+        get_dataset({"data.name": "not_a_dataset"})

@@ -1,0 +1,140 @@
+"""DTU loader against a synthetic MVSNet-layout fixture: cam-file parsing,
+rotation-limited pairing (data.rotation_pi_ratio), eval-view exclusion
+(data.is_exclude_views), and get_dataset dispatch."""
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from mine_tpu.data.dtu import (DTUDataset, parse_dtu_cam, rotation_angle)
+
+W0, H0 = 32, 24
+W, H = 16, 12
+
+
+def _rot_y(deg):
+    a = np.radians(deg)
+    return np.asarray([[np.cos(a), 0, np.sin(a)],
+                       [0, 1, 0],
+                       [-np.sin(a), 0, np.cos(a)]], np.float32)
+
+
+def _cam_txt(R, t, fx=20.0):
+    E = np.eye(4, dtype=np.float32)
+    E[:3, :3] = R
+    E[:3, 3] = t
+    K = np.asarray([[fx, 0, W0 / 2], [0, fx, H0 / 2], [0, 0, 1]], np.float32)
+    lines = ["extrinsic"]
+    lines += [" ".join(f"{v:.6f}" for v in row) for row in E]
+    lines += ["", "intrinsic"]
+    lines += [" ".join(f"{v:.6f}" for v in row) for row in K]
+    lines += ["", "2.5 0.8"]
+    return "\n".join(lines) + "\n"
+
+
+def _make_fixture(root, n_views=6, n_scans=2):
+    # views fan out in yaw: 0, 25, 50, ... degrees — with rotation_pi_ratio=3
+    # (60 deg limit) each view pairs only with nearby ones
+    os.makedirs(os.path.join(root, "Cameras"), exist_ok=True)
+    rng = np.random.RandomState(0)
+    for v in range(n_views):
+        with open(os.path.join(root, "Cameras", "%08d_cam.txt" % v), "w") as f:
+            f.write(_cam_txt(_rot_y(25.0 * v), [0.1 * v, 0, 0]))
+    for s in range(1, n_scans + 1):
+        d = os.path.join(root, "Rectified", f"scan{s}_train")
+        os.makedirs(d, exist_ok=True)
+        for v in range(n_views):
+            for light in ("0", "3"):
+                img = (rng.uniform(size=(H0, W0, 3)) * 255).astype(np.uint8)
+                Image.fromarray(img).save(
+                    os.path.join(d, "rect_%03d_%s_r5000.png" % (v + 1, light)))
+
+
+def test_cam_parsing_and_rotation_angle(tmp_path):
+    _make_fixture(str(tmp_path))
+    cam = parse_dtu_cam(str(tmp_path / "Cameras" / "00000002_cam.txt"))
+    assert cam["extrinsic"].shape == (4, 4)
+    np.testing.assert_allclose(cam["extrinsic"][:3, :3], _rot_y(50),
+                               atol=1e-5)
+    np.testing.assert_allclose(cam["intrinsic"][0, 0], 20.0)
+    np.testing.assert_allclose(cam["depth"], [2.5, 0.8])
+    np.testing.assert_allclose(
+        np.degrees(rotation_angle(_rot_y(0), _rot_y(50))), 50.0, rtol=1e-5)
+
+
+def test_rotation_limited_pairing(tmp_path):
+    _make_fixture(str(tmp_path))
+    ds = DTUDataset(str(tmp_path), is_validation=True, img_size=(W, H),
+                    rotation_pi_ratio=3.0,  # 60 deg limit
+                    intrinsics_scale=1.0)   # fixture stores native-scale K
+    # view 0 (yaw 0) pairs with views at 25 and 50 deg only
+    assert ds.pair_views[0] == [1, 2]
+    assert ds.pair_views[3] == [1, 2, 4, 5]
+    assert len(ds) == 12  # 2 scans x 6 views, all have qualifying targets
+
+    rng = np.random.RandomState(0)
+    src, tgt = ds.get_item(0, rng)
+    assert src["img"].shape == (H, W, 3)
+    # G_src_tgt consistent with the fixture extrinsics
+    expect = ds.cams[0]["extrinsic"] @ np.linalg.inv(
+        ds.cams[ds.pair_views[0][0]]["extrinsic"])
+    np.testing.assert_allclose(tgt["G_src_tgt"], expect, atol=1e-5)
+    # intrinsics rescaled
+    np.testing.assert_allclose(src["K"][0, 0], 20.0 * W / W0)
+
+    b = next(ds.batch_iterator(batch_size=3, shuffle=False))
+    assert b["src_img"].shape == (3, H, W, 3)
+
+
+def test_intrinsics_scale_default_quarter_res(tmp_path):
+    """MVSNet cam files are quarter-resolution: default scale is 4x."""
+    _make_fixture(str(tmp_path))
+    ds = DTUDataset(str(tmp_path), is_validation=True, img_size=(W, H))
+    src, _ = ds.get_item(0, np.random.RandomState(0))
+    np.testing.assert_allclose(src["K"][0, 0], 4.0 * 20.0 * W / W0)
+    np.testing.assert_allclose(src["K"][2], [0, 0, 1])
+
+
+def test_cameras_train_subdir_layout(tmp_path):
+    """Standard mvs_training checkout nests cam files in Cameras/train/."""
+    import shutil
+
+    _make_fixture(str(tmp_path))
+    cam_dir = tmp_path / "Cameras"
+    (cam_dir / "train").mkdir()
+    for p in cam_dir.glob("*_cam.txt"):
+        shutil.move(str(p), str(cam_dir / "train" / p.name))
+    ds = DTUDataset(str(tmp_path), is_validation=True, img_size=(W, H))
+    assert len(ds.cams) == 6
+
+
+def test_exclude_eval_views(tmp_path):
+    _make_fixture(str(tmp_path))
+    ds = DTUDataset(str(tmp_path), is_validation=False, img_size=(W, H),
+                    is_exclude_views=True)
+    # view 3 is in the standard eval subset: excluded from training items
+    assert all(v != 3 for _, v in ds.items)
+    ds_val = DTUDataset(str(tmp_path), is_validation=True, img_size=(W, H),
+                        is_exclude_views=True)
+    assert any(v == 3 for _, v in ds_val.items)  # kept for validation
+
+
+def test_get_dataset_dispatch(tmp_path):
+    import os as _os
+
+    from mine_tpu.config import CONFIG_DIR, load_config, mpi_config_from_dict
+    from mine_tpu.data.llff import get_dataset
+
+    _make_fixture(str(tmp_path))
+    cfg = load_config(_os.path.join(CONFIG_DIR, "params_dtu.yaml"))
+    cfg.update({
+        "data.training_set_path": str(tmp_path),
+        "data.val_set_path": str(tmp_path),
+        "data.img_w": W, "data.img_h": H,
+    })
+    train, val = get_dataset(cfg)
+    assert len(train) > 0 and len(val) > 0
+    mc = mpi_config_from_dict(cfg)
+    assert mc.is_bg_depth_inf          # dtu's far-background depth mode
+    assert not mc.use_disparity_loss   # no-SfM-points dataset

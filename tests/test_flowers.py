@@ -1,0 +1,100 @@
+"""Flowers light-field loader against a synthetic ESLF fixture: sub-aperture
+extraction, cam_params parsing (the reference's shipped asset format,
+input_pipelines/flowers/cam_params.txt), pairing, and get_dataset dispatch."""
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from mine_tpu.data.flowers import (FlowersDataset, extract_subaperture,
+                                   parse_cam_params)
+
+G, S = 2, 4          # tiny grid: 2x2 calibrated views in a 4x4 lenslet
+H, W = 8, 8          # sub-aperture resolution
+OFF = (S - G) // 2   # = 1
+
+
+def _cam_line(r, c):
+    pose = [1, 0, 0, 0.5 - 0.01 * c, 0, 1, 0, 0.5 - 0.01 * r, 0, 0, 1, 0]
+    vals = [f"{r}_{c}", 0.9, 1.2, 0.5 + 0.002 * c, 0.5 + 0.002 * r, 0.0, 0.0]
+    return " ".join(str(v) for v in vals + pose)
+
+
+def _make_fixture(root, n_scenes=3):
+    os.makedirs(os.path.join(root, "imgs"), exist_ok=True)
+    os.makedirs(os.path.join(root, "dataset_list"), exist_ok=True)
+    with open(os.path.join(root, "cam_params.txt"), "w") as f:
+        for r in range(G):
+            for c in range(G):
+                f.write(_cam_line(r, c) + "\n")
+    names = []
+    for i in range(n_scenes):
+        # ESLF image whose sub-view (u,v) is a constant color encoding (u,v)
+        eslf = np.zeros((H * S, W * S, 3), np.uint8)
+        for u in range(S):
+            for v in range(S):
+                eslf[u::S, v::S] = (10 + 40 * u, 10 + 40 * v, 50 * i)
+        name = f"imgs/scene{i}_eslf.png"
+        Image.fromarray(eslf).save(os.path.join(root, name))
+        names.append(name)
+    with open(os.path.join(root, "dataset_list", "train.list"), "w") as f:
+        f.write("\n".join(names[:-1]) + "\n")
+    with open(os.path.join(root, "dataset_list", "test.list"), "w") as f:
+        f.write(names[-1] + "\n")
+
+
+def test_parse_cam_params(tmp_path):
+    _make_fixture(str(tmp_path))
+    cams = parse_cam_params(str(tmp_path / "cam_params.txt"))
+    assert set(cams) == {(r, c) for r in range(G) for c in range(G)}
+    np.testing.assert_allclose(cams[(1, 0)]["pose"][:, 3], [0.5, 0.49, 0.0])
+    np.testing.assert_allclose(cams[(0, 1)]["intrinsics"],
+                               [0.9, 1.2, 0.502, 0.5])
+
+
+def test_subaperture_extraction_layout():
+    eslf = np.arange(4 * 4).reshape(4, 4, 1).astype(np.float32)
+    v00 = extract_subaperture(eslf, 0, 0, 2)
+    np.testing.assert_array_equal(v00[..., 0], [[0, 2], [8, 10]])
+    v11 = extract_subaperture(eslf, 1, 1, 2)
+    np.testing.assert_array_equal(v11[..., 0], [[5, 7], [13, 15]])
+
+
+def test_items_and_dispatch(tmp_path):
+    _make_fixture(str(tmp_path))
+    ds = FlowersDataset(str(tmp_path), is_validation=False, img_size=(W, H),
+                        grid=G, lenslet_stride=S)
+    assert len(ds) == 2  # train.list
+    rng = np.random.RandomState(0)
+    src, tgt = ds.get_item(0, rng)
+    # src = center view (1,1) of scene 0 -> eslf sub-view (1+OFF, 1+OFF)
+    np.testing.assert_allclose(src["img"][0, 0],
+                               [(10 + 40 * (1 + OFF)) / 255.0,
+                                (10 + 40 * (1 + OFF)) / 255.0, 0.0],
+                               atol=1 / 255.0)
+    assert tgt["G_src_tgt"].shape == (4, 4)
+    # identity rotations: translation = t_src - t_tgt, nonzero for any tgt
+    assert np.abs(tgt["G_src_tgt"][:3, 3]).max() > 0
+    b = next(ds.batch_iterator(batch_size=2, shuffle=False))
+    assert b["src_img"].shape == (2, H, W, 3)
+    assert b["pt3d_src"].shape == (2, 3, 1)
+
+    from mine_tpu.data.llff import get_dataset
+    cfg = {
+        "data.name": "flowers",
+        "data.training_set_path": str(tmp_path),
+        "data.val_set_path": str(tmp_path),
+        "data.img_w": W, "data.img_h": H,
+        "data.lenslet_grid": G, "data.lenslet_stride": S,
+    }
+    train, val = get_dataset(cfg)
+    assert len(train) == 2 and len(val) == 1
+    bv = next(val.batch_iterator(batch_size=1, shuffle=False,
+                                 drop_last=False))
+    assert bv["src_img"].shape == (1, H, W, 3)
+
+    from mine_tpu.config import mpi_config_from_dict
+    mc = mpi_config_from_dict(dict(cfg))
+    # flowers is a no-SfM-points dataset (synthesis_task.py:213-214)
+    assert not mc.use_disparity_loss and not mc.use_scale_factor

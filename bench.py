@@ -3,17 +3,32 @@
 
 Measures the full jitted train step (forward + 4-scale loss + backward +
 two-group Adam) on the north-star config — LLFF 384x256, N=32 planes,
-per-device batch 2, ResNet-50 backbone, bfloat16 conv stacks (BASELINE.md /
-BASELINE.json: "LLFF 384x256 N=32 training at >=4x the V100x2 images/sec").
+ResNet-50 backbone, bfloat16 conv stacks (BASELINE.md / BASELINE.json:
+"LLFF 384x256 N=32 training at >=4x the V100x2 images/sec").
+
+Sweeps a small variant grid — per-chip batch size and the Pallas kernel
+backends (training.warp_backend / composite_backend = pallas_diff, the
+banded warp + fused composite custom-VJP pairs) — and reports the FASTEST
+as the headline number. Every variant is isolated: a kernel that fails to
+compile or OOMs on device is recorded in the variants table and skipped,
+never fatal (the Pallas kernels are interpret-validated but this may be
+their first on-device compile; ROADMAP "Blocked on hardware").
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+   "best_config": "...", "variants": {name: images/sec | "error: ..."}}
 
 vs_baseline uses the documented V100x2 reference estimate in BASELINE.md
 (ESTIMATED_REFERENCE_IMAGES_PER_SEC below): the repo publishes no measured
-number and this container has no GPU to measure one (SURVEY.md section 6), so
-the denominator is an engineering estimate of the reference's 2xV100 fp32
-throughput at its shipped config — recorded, not guessed silently.
+number and this container has no GPU to measure one (SURVEY.md section 6),
+so the denominator is an engineering estimate of the reference's 2xV100
+fp32 throughput at its shipped config — recorded, not guessed silently.
+
+Env knobs:
+  MINE_TPU_BENCH_PROFILE=<dir>   capture a jax.profiler trace of the winner
+  MINE_TPU_BENCH_VARIANTS=a,b    run only the named variants
+  MINE_TPU_BENCH_SMOKE=1         tiny shapes / few steps — harness self-test
+                                 on CPU, NOT a benchmark
 """
 
 import json
@@ -25,59 +40,139 @@ import time
 # See BASELINE.md "Estimated reference throughput" for the derivation.
 ESTIMATED_REFERENCE_IMAGES_PER_SEC = 4.0
 
-BATCH = 2
-HEIGHT, WIDTH = 256, 384
-PLANES = 32
-WARMUP_STEPS = 3
-MEASURE_STEPS = 20
+SMOKE = os.environ.get("MINE_TPU_BENCH_SMOKE") == "1"
+HEIGHT, WIDTH = (64, 64) if SMOKE else (256, 384)
+PLANES = 4 if SMOKE else 32
+NUM_LAYERS = 18 if SMOKE else 50
+WARMUP_STEPS = 1 if SMOKE else 3
+MEASURE_STEPS = 2 if SMOKE else 20
+
+# name -> (batch, warp_backend, composite_backend)
+VARIANTS = {
+    "xla_b2": (2, "xla", "xla"),
+    "xla_b4": (4, "xla", "xla"),
+    "xla_b8": (8, "xla", "xla"),
+    "pallas_b2": (2, "pallas_diff", "pallas_diff"),
+    "pallas_b4": (4, "pallas_diff", "pallas_diff"),
+}
 
 
-def main():
+def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
+    """Compile + run one variant; returns (images_per_sec, run_fn|None).
+
+    run_fn (for the profiler) pins the variant's state/executables in device
+    memory — only kept when requested, so earlier variants can't skew later
+    ones toward OOM."""
     import jax
     import jax.numpy as jnp
 
-    from mine_tpu.config import CONFIG_DIR, load_config
     from mine_tpu.data.synthetic import make_batch
     from mine_tpu.train.step import SynthesisTrainer
 
-    profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")  # jax.profiler trace
-    config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
-    config.update({
-        "data.img_h": HEIGHT, "data.img_w": WIDTH,
-        "data.per_gpu_batch_size": BATCH,
-        "mpi.num_bins_coarse": PLANES,
-        "model.num_layers": 50,
-        "training.dtype": "bfloat16",
-    })
-
     trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
-    state = trainer.init_state(batch_size=BATCH)
+    state = trainer.init_state(batch_size=batch_size)
     batch = {k: jnp.asarray(v) for k, v in
-             make_batch(BATCH, HEIGHT, WIDTH, num_points=256).items()}
+             make_batch(batch_size, HEIGHT, WIDTH, num_points=256).items()}
 
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.train_step(state, batch)
     jax.block_until_ready(metrics)
 
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = trainer.train_step(state, batch)
-    jax.block_until_ready(metrics)
-    dt = time.perf_counter() - t0
-    if profile_dir:
-        jax.profiler.stop_trace()
+    def run(n):
+        nonlocal state, metrics
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = trainer.train_step(state, batch)
+        jax.block_until_ready(metrics)
+        return time.perf_counter() - t0
 
-    images_per_sec = BATCH * MEASURE_STEPS / dt
-    result = {
-        "metric": "LLFF 384x256 N=32 train images/sec (1 chip, bf16, ResNet-50)",
-        "value": round(images_per_sec, 3),
-        "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / ESTIMATED_REFERENCE_IMAGES_PER_SEC, 3),
-    }
+    dt = run(steps)
+    return batch_size * steps / dt, (run if keep_run else None)
+
+
+def main():
+    import jax
+
+    from mine_tpu.config import CONFIG_DIR, load_config
+
+    profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
+    only = os.environ.get("MINE_TPU_BENCH_VARIANTS")
+    names = [n.strip() for n in only.split(",") if n.strip()] if only \
+        else list(VARIANTS)
+    unknown = [n for n in names if n not in VARIANTS]
+    if unknown or not names:
+        print("unknown MINE_TPU_BENCH_VARIANTS %s (known: %s)"
+              % (unknown, sorted(VARIANTS)), file=sys.stderr)
+        sys.exit(2)
+
+    base = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+    base.update({
+        "data.img_h": HEIGHT, "data.img_w": WIDTH,
+        "mpi.num_bins_coarse": PLANES,
+        "model.num_layers": NUM_LAYERS,
+        "training.dtype": "float32" if SMOKE else "bfloat16",
+    })
+
+    results = {}
+    best_name, best_ips, best_run = None, 0.0, None
+    for name in names:
+        batch, warp_be, comp_be = VARIANTS[name]
+        config = dict(base)
+        config.update({
+            "data.per_gpu_batch_size": batch,
+            "training.warp_backend": warp_be,
+            "training.composite_backend": comp_be,
+        })
+        try:
+            ips, _ = _measure(config, batch)
+        except Exception as e:  # compile failure / OOM: record, continue
+            msg = (str(e).splitlines() or [repr(e)])[0][:200]
+            results[name] = "error: %s" % msg
+            print("variant %s failed: %s" % (name, results[name]),
+                  file=sys.stderr)
+            continue
+        results[name] = round(ips, 3)
+        print("variant %s: %.3f images/sec" % (name, ips), file=sys.stderr)
+        if ips > best_ips:
+            best_name, best_ips = name, ips
+
+    metric = "LLFF 384x256 N=32 train images/sec (1 chip, bf16, ResNet-50)"
+    if SMOKE:
+        metric = "SMOKE harness self-test (tiny shapes, not a benchmark)"
+
+    if best_name is None:
+        print(json.dumps({
+            "metric": metric,
+            "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+            "variants": results, "error": "all variants failed"}))
+        sys.exit(1)
+
     if profile_dir:
-        result["profiled"] = True  # tracing overhead included — not a baseline
+        # re-run the winner fresh (the sweep retains no device state)
+        batch, warp_be, comp_be = VARIANTS[best_name]
+        config = dict(base)
+        config.update({
+            "data.per_gpu_batch_size": batch,
+            "training.warp_backend": warp_be,
+            "training.composite_backend": comp_be,
+        })
+        _, run = _measure(config, batch, steps=1, keep_run=True)
+        jax.profiler.start_trace(profile_dir)
+        run(5)
+        jax.profiler.stop_trace()
+        print("profiler trace (winner=%s) in %s" % (best_name, profile_dir),
+              file=sys.stderr)
+
+    result = {
+        "metric": metric,
+        "value": round(best_ips, 3),
+        "unit": "images/sec",
+        # SMOKE throughput is meaningless against the real-config estimate
+        "vs_baseline": None if SMOKE else round(
+            best_ips / ESTIMATED_REFERENCE_IMAGES_PER_SEC, 3),
+        "best_config": best_name,
+        "variants": results,
+    }
     print(json.dumps(result))
 
 

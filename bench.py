@@ -114,7 +114,7 @@ def main():
     })
 
     results = {}
-    best_name, best_ips, best_run = None, 0.0, None
+    best_name, best_ips = None, 0.0
     for name in names:
         batch, warp_be, comp_be = VARIANTS[name]
         config = dict(base)

@@ -101,8 +101,10 @@ class MPIConfig:
     # training.* / data.*
     src_rgb_blending: bool = True
     use_multi_scale: bool = True
-    # "xla" | "pallas_diff": backend for the novel-view composite inside the
-    # loss graph (pallas_diff = fused Pallas forward + custom-VJP backward)
+    # "xla" | "pallas_diff" | "plane_scan": backend for the novel-view
+    # composite inside the loss graph (pallas_diff = fused Pallas forward +
+    # custom-VJP backward; plane_scan = distributed plane-axis transparency
+    # scan for plane-parallel meshes, ops/plane_scan.py)
     composite_backend: str = "xla"
     # "xla" | "pallas_diff": backend for the training-path homography warp
     # ("pallas_diff" = banded MXU kernel fwd+bwd with a runtime gather
@@ -138,9 +140,9 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
     # "pallas" (forward-only) is an internal render-path backend; the training
     # loss graph differentiates through the composite, so only the custom-VJP
     # variant is valid here.
-    if backend not in ("xla", "pallas_diff"):
+    if backend not in ("xla", "pallas_diff", "plane_scan"):
         raise ValueError(
-            f"training.composite_backend must be xla|pallas_diff, "
+            f"training.composite_backend must be xla|pallas_diff|plane_scan, "
             f"got {backend!r}")
     warp_backend = g("training.warp_backend", "xla")
     if warp_backend not in ("xla", "pallas_diff"):

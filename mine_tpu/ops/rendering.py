@@ -180,7 +180,24 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         # so use the XLA composite instead of shard_map
         backend = "xla"
 
-    if backend in ("pallas", "pallas_diff") and not use_alpha:
+    if backend == "plane_scan":
+        # distributed two-level transparency scan over the plane axis
+        # (ops/plane_scan.py) — the volume stays plane-sharded end to end.
+        # Requires a plane-divisible mesh; otherwise the XLA composite.
+        from mine_tpu.parallel.mesh import PLANE_AXIS
+        if (mesh is not None and mesh.size > 1 and not use_alpha
+                and S % mesh.shape.get(PLANE_AXIS, 1) == 0):
+            from mine_tpu.ops.plane_scan import plane_sharded_volume_render
+            rgb_syn, depth_syn = plane_sharded_volume_render(
+                tgt_rgb, tgt_sigma, tgt_xyz, mesh,
+                z_mask=True, is_bg_depth_inf=is_bg_depth_inf)
+            backend = "done"
+        else:
+            backend = "xla"
+
+    if backend == "done":
+        pass  # composited above; shared mask/TgtRender tail below
+    elif backend in ("pallas", "pallas_diff") and not use_alpha:
         # fused composite: z-masking + volume rendering in one HBM pass
         # (mine_tpu.kernels.composite). "pallas" is forward-only;
         # "pallas_diff" adds the custom-VJP backward kernel for training.

@@ -47,13 +47,14 @@ NUM_LAYERS = 18 if SMOKE else 50
 WARMUP_STEPS = 1 if SMOKE else 3
 MEASURE_STEPS = 2 if SMOKE else 20
 
-# name -> (batch, warp_backend, composite_backend)
+# name -> (batch, warp_backend, composite_backend, warp_dtype)
 VARIANTS = {
-    "xla_b2": (2, "xla", "xla"),
-    "xla_b4": (4, "xla", "xla"),
-    "xla_b8": (8, "xla", "xla"),
-    "pallas_b2": (2, "pallas_diff", "pallas_diff"),
-    "pallas_b4": (4, "pallas_diff", "pallas_diff"),
+    "xla_b2": (2, "xla", "xla", "float32"),
+    "xla_b4": (4, "xla", "xla", "float32"),
+    "xla_b8": (8, "xla", "xla", "float32"),
+    "pallas_b2": (2, "pallas_diff", "pallas_diff", "float32"),
+    "pallas_b4": (4, "pallas_diff", "pallas_diff", "float32"),
+    "pallas_bf16_b4": (4, "pallas_diff", "pallas_diff", "bfloat16"),
 }
 
 
@@ -116,12 +117,13 @@ def main():
     results = {}
     best_name, best_ips = None, 0.0
     for name in names:
-        batch, warp_be, comp_be = VARIANTS[name]
+        batch, warp_be, comp_be, warp_dt = VARIANTS[name]
         config = dict(base)
         config.update({
             "data.per_gpu_batch_size": batch,
             "training.warp_backend": warp_be,
             "training.composite_backend": comp_be,
+            "training.warp_dtype": warp_dt,
         })
         try:
             ips, _ = _measure(config, batch)
@@ -149,12 +151,13 @@ def main():
 
     if profile_dir:
         # re-run the winner fresh (the sweep retains no device state)
-        batch, warp_be, comp_be = VARIANTS[best_name]
+        batch, warp_be, comp_be, warp_dt = VARIANTS[best_name]
         config = dict(base)
         config.update({
             "data.per_gpu_batch_size": batch,
             "training.warp_backend": warp_be,
             "training.composite_backend": comp_be,
+            "training.warp_dtype": warp_dt,
         })
         _, run = _measure(config, batch, steps=1, keep_run=True)
         jax.profiler.start_trace(profile_dir)

@@ -111,6 +111,9 @@ class MPIConfig:
     # fallback for rotation-heavy poses; kernels/warp_vjp.py)
     warp_backend: str = "xla"
     warp_band: int = 32
+    # matmul operand dtype inside the banded warp kernels ("float32" |
+    # "bfloat16"; bf16 doubles MXU rate at ~2^-8 weight rounding)
+    warp_dtype: str = "float32"
     use_disparity_loss: bool = True   # disp_lambda=0 for flowers/kitti_raw/dtu
     use_scale_factor: bool = True     # scale_factor=1 for flowers/kitti_raw/dtu
     img_h: int = 384
@@ -149,6 +152,11 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         raise ValueError(
             f"training.warp_backend must be xla|pallas_diff, "
             f"got {warp_backend!r}")
+    warp_dtype = g("training.warp_dtype", "float32")
+    if warp_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"training.warp_dtype must be float32|bfloat16, "
+            f"got {warp_dtype!r}")
     return MPIConfig(
         num_bins_coarse=g("mpi.num_bins_coarse", 32),
         num_bins_fine=g("mpi.num_bins_fine", 0),
@@ -170,6 +178,7 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         composite_backend=backend,
         warp_backend=warp_backend,
         warp_band=int(g("training.warp_band", 32)),
+        warp_dtype=warp_dtype,
         # visible_point_count == 0 also disables the sparse-point terms —
         # datasets with no SfM points (public RealEstate10K) train scale-free
         use_disparity_loss=(name not in _NO_DISP_DATASETS

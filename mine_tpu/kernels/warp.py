@@ -41,7 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
-                 y0_ref, xc_ref, yc_ref, src_ref, out_ref,
+                 mxu_dtype, y0_ref, xc_ref, yc_ref, src_ref, out_ref,
                  band_buf, sem):
     W_t = xc_ref.shape[2]
     y0 = y0_ref[0, 0]
@@ -51,7 +51,9 @@ def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
     dma.start()
     dma.wait()
 
-    band = band_buf[:].reshape(C * BAND, W_s)
+    # mxu_dtype=bfloat16 halves the matmul operand width (2x MXU rate);
+    # tent weights pick up ~2^-8 relative rounding, accumulation stays f32
+    band = band_buf[:].reshape(C * BAND, W_s).astype(mxu_dtype)
     xs = jax.lax.broadcasted_iota(jnp.float32, (W_s, W_t), 0)
     ys = jax.lax.broadcasted_iota(jnp.float32, (BAND, W_t), 0)
 
@@ -61,25 +63,30 @@ def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
         sy = jnp.clip(sy, 0.0, BAND - 1.0)              # band coverage clamp
 
         wx = jnp.maximum(1.0 - jnp.abs(xs - sx), 0.0)   # [W_s, W_t]
-        t = jnp.dot(band, wx, preferred_element_type=jnp.float32)
+        t = jnp.dot(band, wx.astype(mxu_dtype),
+                    preferred_element_type=jnp.float32)
         t = t.reshape(C, BAND, W_t)
         wy = jnp.maximum(1.0 - jnp.abs(ys - sy), 0.0)   # [BAND, W_t]
         out_ref[0, :, r, :] = jnp.sum(t * wy[None], axis=1)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("band", "rows_per_block", "interpret"))
+                   static_argnames=("band", "rows_per_block", "interpret",
+                                    "mxu_dtype"))
 def pallas_bilinear_sample(src: jnp.ndarray,
                            coords_x: jnp.ndarray,
                            coords_y: jnp.ndarray,
                            band: int = 16,
                            rows_per_block: int = 8,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False,
+                           mxu_dtype=jnp.float32) -> jnp.ndarray:
     """Banded-gather equivalent of ops.warp.bilinear_sample.
 
     Args:
       src: [B', C, H_s, W_s]
       coords_x, coords_y: [B', H_t, W_t] source pixel coordinates
+      mxu_dtype: matmul operand dtype (jnp.bfloat16 doubles MXU rate at
+        ~2^-8 relative weight rounding; accumulation is always f32)
     Returns: [B', C, H_t, W_t]
     """
     Bp, C, H_s, W_s = src.shape
@@ -100,7 +107,8 @@ def pallas_bilinear_sample(src: jnp.ndarray,
     y0 = jnp.clip(y0, 0, max(H_s - band, 0))  # [B', NB]
 
     grid = (Bp, NB)
-    kernel = functools.partial(_warp_kernel, C, band, RT, H_s, W_s)
+    kernel = functools.partial(_warp_kernel, C, band, RT, H_s, W_s,
+                               mxu_dtype)
 
     return pl.pallas_call(
         kernel,

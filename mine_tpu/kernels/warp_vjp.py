@@ -49,7 +49,7 @@ from mine_tpu.kernels.warp import band_span, pallas_bilinear_sample
 
 
 def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
-                o0_ref, g_ref, xc_ref, yc_ref, out_ref,
+                mxu_dtype, o0_ref, g_ref, xc_ref, yc_ref, out_ref,
                 g_buf, xc_buf, yc_buf, sem_g, sem_x, sem_y):
     """Grid step (b, source-row-block): splat OBAND gradient rows into RS
     source rows via transposed tent-weight contractions."""
@@ -79,8 +79,9 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
         wy = jnp.maximum(1.0 - jnp.abs(hs - sy), 0.0)   # [RS, W_t]
         m = g_buf[:, ob, :][:, None, :] * wy[None]      # [C, RS, W_t]
         wxT = jnp.maximum(1.0 - jnp.abs(ws - sx.T), 0.0)  # [W_t, W_s]
-        accum = accum + jnp.dot(m.reshape(C * RS, W_t), wxT,
-                                preferred_element_type=jnp.float32)
+        accum = accum + jnp.dot(
+            m.reshape(C * RS, W_t).astype(mxu_dtype),
+            wxT.astype(mxu_dtype), preferred_element_type=jnp.float32)
     out_ref[0] = accum.reshape(C, RS, W_s)
 
 
@@ -109,9 +110,11 @@ def _clip_coords(src_shape, coords_x, coords_y):
 
 
 @functools.partial(jax.jit, static_argnames=("src_shape", "oband",
-                                             "rows_per_block", "interpret"))
+                                             "rows_per_block", "interpret",
+                                             "mxu_dtype"))
 def _warp_bwd(g, coords_x, coords_y, src_shape,
-              oband: int, rows_per_block: int, interpret: bool):
+              oband: int, rows_per_block: int, interpret: bool,
+              mxu_dtype=jnp.float32):
     Bp, C, H_s, W_s = src_shape
     _, H_t, W_t = coords_x.shape
     RS = rows_per_block
@@ -124,7 +127,8 @@ def _warp_bwd(g, coords_x, coords_y, src_shape,
     o0 = jnp.where(any_touch, first, 0)
     o0 = jnp.clip(o0, 0, max(H_t - oband, 0)).astype(jnp.int32)  # [Bp, NBs]
 
-    kernel = functools.partial(_bwd_kernel, C, oband, RS, H_t, W_t)
+    kernel = functools.partial(_bwd_kernel, C, oband, RS, H_t, W_t,
+                               mxu_dtype)
     return pl.pallas_call(
         kernel,
         grid=(Bp, NBs),
@@ -153,12 +157,13 @@ def _warp_bwd(g, coords_x, coords_y, src_shape,
     )(o0, g.astype(jnp.float32), xc, yc)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def bilinear_sample_diff(src, coords_x, coords_y,
                          band: int = 32,
                          oband: int = 32,
                          rows_per_block: int = 8,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         mxu_dtype=jnp.float32):
     """Differentiable banded bilinear sample: Pallas fwd + Pallas bwd.
 
     Same contract as ops.warp.bilinear_sample within the band domain (see
@@ -166,21 +171,23 @@ def bilinear_sample_diff(src, coords_x, coords_y,
     correctness). Gradient flows to src; coords receive zeros."""
     return pallas_bilinear_sample(src, coords_x, coords_y, band=band,
                                   rows_per_block=rows_per_block,
-                                  interpret=interpret)
+                                  interpret=interpret, mxu_dtype=mxu_dtype)
 
 
-def _diff_fwd(src, coords_x, coords_y, band, oband, rows_per_block, interpret):
+def _diff_fwd(src, coords_x, coords_y, band, oband, rows_per_block,
+              interpret, mxu_dtype):
     out = pallas_bilinear_sample(src, coords_x, coords_y, band=band,
                                  rows_per_block=rows_per_block,
-                                 interpret=interpret)
+                                 interpret=interpret, mxu_dtype=mxu_dtype)
     return out, (src.shape, coords_x, coords_y)
 
 
-def _diff_bwd(band, oband, rows_per_block, interpret, residuals, g):
+def _diff_bwd(band, oband, rows_per_block, interpret, mxu_dtype,
+              residuals, g):
     src_shape, coords_x, coords_y = residuals
     d_src = _warp_bwd(g, coords_x, coords_y, src_shape=src_shape,
                       oband=oband, rows_per_block=rows_per_block,
-                      interpret=interpret)
+                      interpret=interpret, mxu_dtype=mxu_dtype)
     return d_src, jnp.zeros_like(coords_x), jnp.zeros_like(coords_y)
 
 
@@ -208,7 +215,8 @@ def bilinear_sample_diff_guarded(src, coords_x, coords_y,
                                  band: int = 32,
                                  oband: int = 32,
                                  rows_per_block: int = 8,
-                                 interpret: bool = False):
+                                 interpret: bool = False,
+                                 mxu_dtype=jnp.float32):
     """Banded differentiable warp with a runtime XLA-gather fallback.
 
     `lax.cond` on the (data-dependent, pose-derived) band-domain check: the
@@ -230,6 +238,6 @@ def bilinear_sample_diff_guarded(src, coords_x, coords_y,
     return jax.lax.cond(
         ok,
         lambda s, x, y: bilinear_sample_diff(
-            s, x, y, band, oband, rows_per_block, interpret),
+            s, x, y, band, oband, rows_per_block, interpret, mxu_dtype),
         lambda s, x, y: bilinear_sample(s, x, y),
         src, coords_x, coords_y)

@@ -115,11 +115,7 @@ def plane_sharded_volume_render(rgb_BS3HW: jnp.ndarray,
                   check_vma=False)
     out = f(rgb_BS3HW.astype(jnp.float32), sigma_BS1HW.astype(jnp.float32),
             xyz_BS3HW.astype(jnp.float32))
+    from mine_tpu.ops.rendering import finalize_depth
     rgb_out = out[:, 0:3]
-    depth_acc = out[:, 3:4]
-    weights_sum = out[:, 4:5]
-    if is_bg_depth_inf:
-        depth_out = depth_acc + (1.0 - weights_sum) * 1000.0
-    else:
-        depth_out = depth_acc / (weights_sum + 1e-5)
+    depth_out = finalize_depth(out[:, 3:4], out[:, 4:5], is_bg_depth_inf)
     return rgb_out, depth_out

@@ -34,24 +34,30 @@ def alpha_composition(alpha_BK1HW: jnp.ndarray,
     return composed, weights
 
 
+def finalize_depth(depth_acc: jnp.ndarray,
+                   weights_sum: jnp.ndarray,
+                   is_bg_depth_inf: bool) -> jnp.ndarray:
+    """Depth finalization shared by every composite backend: weight-normalize,
+    or add a far background (+1000*(1-w_sum)) when `is_bg_depth_inf` (DTU
+    mode). Reference: mpi_rendering.weighted_sum_mpi (mpi_rendering.py:74-77).
+    """
+    if is_bg_depth_inf:
+        return depth_acc + (1.0 - weights_sum) * 1000.0
+    return depth_acc / (weights_sum + 1e-5)
+
+
 def weighted_sum_mpi(rgb_BS3HW: jnp.ndarray,
                      xyz_BS3HW: jnp.ndarray,
                      weights: jnp.ndarray,
                      is_bg_depth_inf: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Composite rgb and depth from per-plane weights.
 
-    Reference: mpi_rendering.weighted_sum_mpi (mpi_rendering.py:70-82):
-    depth is weight-normalized, or gets a far background (+1000*(1-w_sum))
-    when `is_bg_depth_inf` (DTU mode).
+    Reference: mpi_rendering.weighted_sum_mpi (mpi_rendering.py:70-82).
     """
     weights_sum = jnp.sum(weights, axis=1)  # [B,1,H,W]
     rgb_out = jnp.sum(weights * rgb_BS3HW, axis=1)  # [B,3,H,W]
     depth_acc = jnp.sum(weights * xyz_BS3HW[:, :, 2:3], axis=1)
-    if is_bg_depth_inf:
-        depth_out = depth_acc + (1.0 - weights_sum) * 1000.0
-    else:
-        depth_out = depth_acc / (weights_sum + 1e-5)
-    return rgb_out, depth_out
+    return rgb_out, finalize_depth(depth_acc, weights_sum, is_bg_depth_inf)
 
 
 def plane_volume_rendering(rgb_BS3HW: jnp.ndarray,
@@ -110,6 +116,21 @@ def render(rgb_BS3HW: jnp.ndarray,
     return imgs_syn, depth_syn, blend_weights, weights
 
 
+_warned_fallbacks = set()
+
+
+def _warn_backend_fallback(backend: str, why: str) -> None:
+    """One-time trace-time notice when a configured composite backend is
+    silently overridden (runs during tracing, so it fires once per compile,
+    not per step)."""
+    key = (backend, why)
+    if key not in _warned_fallbacks:
+        _warned_fallbacks.add(key)
+        import warnings
+        warnings.warn(
+            f"composite backend {backend!r} falling back to 'xla': {why}")
+
+
 class TgtRender(NamedTuple):
     rgb: jnp.ndarray    # [B,3,H,W]
     depth: jnp.ndarray  # [B,1,H,W]
@@ -128,6 +149,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          backend: str = "xla",
                          warp_impl: str = "xla",
                          warp_band: int = 16,
+                         warp_dtype: str = "float32",
                          mesh=None) -> TgtRender:
     """Render the MPI into a target camera.
 
@@ -166,6 +188,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         impl=warp_impl,
         band=warp_band,
         mesh=mesh,
+        mxu_dtype=jnp.bfloat16 if warp_dtype == "bfloat16" else jnp.float32,
     )
 
     warped = warped.reshape(B, S, 7, H, W)
@@ -174,29 +197,31 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
     tgt_xyz = warped[:, :, 4:7]
 
     if mesh is not None and mesh.size > 1 \
-            and B % mesh.shape.get("data", 1) != 0:
+            and B % mesh.shape.get("data", 1) != 0 and backend != "xla":
         # non-divisible batch (e.g. a remainder eval example): a bare
         # pallas_call inside a GSPMD program carries no partitioning spec,
         # so use the XLA composite instead of shard_map
+        _warn_backend_fallback(backend, "batch not divisible by data axis")
         backend = "xla"
 
     if backend == "plane_scan":
         # distributed two-level transparency scan over the plane axis
         # (ops/plane_scan.py) — the volume stays plane-sharded end to end.
-        # Requires a plane-divisible mesh; otherwise the XLA composite.
+        # Requires a multi-device plane-divisible mesh (see the config
+        # comment in params_default.yaml); otherwise the XLA composite.
         from mine_tpu.parallel.mesh import PLANE_AXIS
-        if (mesh is not None and mesh.size > 1 and not use_alpha
+        if not (mesh is not None and mesh.size > 1 and not use_alpha
                 and S % mesh.shape.get(PLANE_AXIS, 1) == 0):
-            from mine_tpu.ops.plane_scan import plane_sharded_volume_render
-            rgb_syn, depth_syn = plane_sharded_volume_render(
-                tgt_rgb, tgt_sigma, tgt_xyz, mesh,
-                z_mask=True, is_bg_depth_inf=is_bg_depth_inf)
-            backend = "done"
-        else:
+            _warn_backend_fallback(
+                backend, "needs a multi-device mesh with S divisible by the "
+                "plane axis (and sigma mode)")
             backend = "xla"
 
-    if backend == "done":
-        pass  # composited above; shared mask/TgtRender tail below
+    if backend == "plane_scan":
+        from mine_tpu.ops.plane_scan import plane_sharded_volume_render
+        rgb_syn, depth_syn = plane_sharded_volume_render(
+            tgt_rgb, tgt_sigma, tgt_xyz, mesh,
+            z_mask=True, is_bg_depth_inf=is_bg_depth_inf)
     elif backend in ("pallas", "pallas_diff") and not use_alpha:
         # fused composite: z-masking + volume rendering in one HBM pass
         # (mine_tpu.kernels.composite). "pallas" is forward-only;

@@ -78,7 +78,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     meshgrid_tgt: jnp.ndarray,
                     impl: str = "xla",
                     band: int = 16,
-                    mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    mesh=None,
+                    mxu_dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Warp source-plane images into the target camera via inverse homography.
 
     For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
@@ -135,7 +136,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
         from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
         fn = functools.partial(bilinear_sample_diff_guarded,
                                band=band, oband=band,
-                               interpret=not on_tpu_backend())
+                               interpret=not on_tpu_backend(),
+                               mxu_dtype=mxu_dtype)
         xs = jax.lax.stop_gradient(x)
         ys = jax.lax.stop_gradient(y)
         if mesh is not None and mesh.size > 1:

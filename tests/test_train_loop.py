@@ -38,6 +38,31 @@ def test_run_eval_counts_full_val_set(tmp_path):
 
 
 @pytest.mark.slow
+def test_run_eval_multihost_lockstep_caps_collective_calls(tmp_path,
+                                                          monkeypatch):
+    """With uneven per-host shards, every host must make the same number of
+    collective eval_step calls (min over hosts) — extra full batches go to
+    the leftover path instead of deadlocking the mesh jit. Simulated from
+    host 0 of a fake 2-host world."""
+    import mine_tpu.train.loop as loop_mod
+
+    cfg = tiny_config()
+    cfg["data.per_gpu_batch_size"] = 2
+    # 11 items over 2 hosts: host0 gets 6 (3 full batches), host1 5 (2 full
+    # + remainder) -> common collective count is 2
+    data = SyntheticLoaderAdapter(num_views=12)
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=5)
+    loop = TrainLoop(trainer, data, data, str(tmp_path / "ws"),
+                     logger=None, tb_writer=None)
+    monkeypatch.setattr(loop_mod.jax, "process_count", lambda: 2)
+    state = trainer.init_state(batch_size=2)
+    loop.run_eval(state)
+    # host0 evaluates exactly common_full=2 batches x 2 examples; its third
+    # full batch and nothing else goes through the (dropping) leftover path
+    assert loop.val_meters["loss"].count == 4
+
+
+@pytest.mark.slow
 def test_train_loop_runs_epochs_evals_and_resumes(tmp_path):
     cfg = tiny_config()
     cfg.update({

@@ -135,6 +135,30 @@ def test_guarded_fast_path_under_jit():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_bf16_mxu_variant_close_to_f32():
+    """bfloat16 matmul operands: values and grads within the ~2^-8 tent
+    rounding envelope of the f32 path (accumulation stays f32)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    Bp, C, H, W = 2, 5, 32, 48
+    src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+    x, y = _mild_coords(rng, Bp, H, W)
+    cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
+
+    out32 = bilinear_sample_diff(src, x, y, 16, 16, 8, True, jnp.float32)
+    out16 = bilinear_sample_diff(src, x, y, 16, 16, 8, True, jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
+                               rtol=0.05, atol=0.03)
+
+    g32 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
+        s, x, y, 16, 16, 8, True, jnp.float32) * cot))(src)
+    g16 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
+        s, x, y, 16, 16, 8, True, jnp.bfloat16) * cot))(src)
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                               rtol=0.05, atol=0.05)
+
+
 def test_coord_cotangents_are_zero():
     """Coords are non-learnable in MINE (module docstring); the VJP must
     return zero cotangents rather than garbage."""

@@ -217,12 +217,18 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                 "plane axis (and sigma mode)")
             backend = "xla"
 
+    if backend in ("pallas", "pallas_diff") and use_alpha:
+        # the fused kernels implement the sigma-density composite only
+        _warn_backend_fallback(backend, "mpi.use_alpha uses the XLA "
+                               "alpha-compositing path")
+        backend = "xla"
+
     if backend == "plane_scan":
         from mine_tpu.ops.plane_scan import plane_sharded_volume_render
         rgb_syn, depth_syn = plane_sharded_volume_render(
             tgt_rgb, tgt_sigma, tgt_xyz, mesh,
             z_mask=True, is_bg_depth_inf=is_bg_depth_inf)
-    elif backend in ("pallas", "pallas_diff") and not use_alpha:
+    elif backend in ("pallas", "pallas_diff"):
         # fused composite: z-masking + volume rendering in one HBM pass
         # (mine_tpu.kernels.composite). "pallas" is forward-only;
         # "pallas_diff" adds the custom-VJP backward kernel for training.

@@ -78,6 +78,19 @@ def worker(process_id: int) -> None:
     loss = float(metrics["loss"])
     assert np.isfinite(loss), loss
 
+    # plane_scan composite across the process-spanning plane axis: the
+    # distributed transparency scan's halo ppermute / all_gather / psum ride
+    # the cross-process mesh; its loss must match the xla composite's step
+    # from the same initial state
+    config_ps = dict(config)
+    config_ps["training.composite_backend"] = "plane_scan"
+    trainer_ps = SynthesisTrainer(config_ps, mesh=mesh, steps_per_epoch=10)
+    state_ps = trainer_ps.init_state(batch_size=trainer_ps.global_batch_size())
+    _, metrics_ps = trainer_ps.train_step(state_ps, batch)
+    loss_ps = float(metrics_ps["loss"])
+    assert np.isfinite(loss_ps), loss_ps
+    assert abs(loss_ps - loss) < 2e-3 * max(1.0, abs(loss)), (loss_ps, loss)
+
     # all-process checkpoint save of the multi-host-sharded state
     ws = os.environ["SMOKE_WS"]
     mgr = CheckpointManager(ws)

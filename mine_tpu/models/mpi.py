@@ -9,6 +9,7 @@ from __future__ import annotations
 from typing import Any, Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from mine_tpu.models.decoder import MPIDecoder
@@ -40,6 +41,10 @@ class MPIPredictor(nn.Module):
     def __call__(self, src_imgs, disparity, train: bool):
         """src_imgs [B,H,W,3] in [0,1]; disparity [B,S] ->
         list of 4 volumes [B,S,4,H/2^s,W/2^s] (scale order 0,1,2,3)."""
-        feats = self.backbone(src_imgs, train)
-        outputs = self.decoder(list(feats), disparity, train)
+        # named_scope -> HLO metadata: profiler traces attribute time to
+        # encoder vs decoder without guesswork
+        with jax.named_scope("encoder"):
+            feats = self.backbone(src_imgs, train)
+        with jax.named_scope("decoder"):
+            outputs = self.decoder(list(feats), disparity, train)
         return [outputs[s] for s in sorted(outputs)]

@@ -124,15 +124,19 @@ def loss_per_scale(scale: int,
     mpi_rgb = mpi[:, :, 0:3]
     mpi_sigma = mpi[:, :, 3:4]
 
-    src_syn, src_depth, blend_weights, weights = rendering.render(
-        mpi_rgb, mpi_sigma, xyz_src,
-        use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf)
+    with jax.named_scope(f"render_src_s{scale}"):
+        src_syn, src_depth, blend_weights, weights = rendering.render(
+            mpi_rgb, mpi_sigma, xyz_src,
+            use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf)
 
-    if cfg.src_rgb_blending:
-        # visible-from-src planes take the real pixels (synthesis_task.py:267-274)
-        mpi_rgb = blend_weights * src_imgs[:, None] + (1.0 - blend_weights) * mpi_rgb
-        src_syn, src_depth = rendering.weighted_sum_mpi(
-            mpi_rgb, xyz_src, weights, is_bg_depth_inf=cfg.is_bg_depth_inf)
+        if cfg.src_rgb_blending:
+            # visible-from-src planes take the real pixels
+            # (synthesis_task.py:267-274)
+            mpi_rgb = blend_weights * src_imgs[:, None] \
+                + (1.0 - blend_weights) * mpi_rgb
+            src_syn, src_depth = rendering.weighted_sum_mpi(
+                mpi_rgb, xyz_src, weights,
+                is_bg_depth_inf=cfg.is_bg_depth_inf)
 
     src_disp_syn = _safe_reciprocal_depth(src_depth)
 
@@ -154,14 +158,15 @@ def loss_per_scale(scale: int,
         G_tgt_src.at[:, 0:3, 3].set(t_scaled))
     xyz_tgt = geometry.plane_xyz_tgt(xyz_src, G_render)
     xyz_tgt = constrain(xyz_tgt, mesh, DATA_AXIS, PLANE_AXIS)
-    res = rendering.render_tgt_rgb_depth(
-        mpi_rgb, mpi_sigma, disparity, xyz_tgt, G_render,
-        K_src_inv, K_tgt,
-        use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
-        backend=cfg.composite_backend,
-        warp_impl=cfg.warp_backend, warp_band=cfg.warp_band,
-        warp_dtype=cfg.warp_dtype,
-        mesh=mesh if (mesh is not None and mesh.size > 1) else None)
+    with jax.named_scope(f"warp_composite_tgt_s{scale}"):
+        res = rendering.render_tgt_rgb_depth(
+            mpi_rgb, mpi_sigma, disparity, xyz_tgt, G_render,
+            K_src_inv, K_tgt,
+            use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
+            backend=cfg.composite_backend,
+            warp_impl=cfg.warp_backend, warp_band=cfg.warp_band,
+            warp_dtype=cfg.warp_dtype,
+            mesh=mesh if (mesh is not None and mesh.size > 1) else None)
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = _safe_reciprocal_depth(res.depth)
 

@@ -195,9 +195,10 @@ class SynthesisTrainer:
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        updates, new_opt_state = self.tx.update(grads, state.opt_state,
-                                                state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("adam_update"):
+            updates, new_opt_state = self.tx.update(grads, state.opt_state,
+                                                    state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1,
                                params=new_params,
                                batch_stats=new_stats,

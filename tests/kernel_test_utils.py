@@ -7,16 +7,14 @@ kernels on the TPU backend — the on-device validation pass of ROADMAP
 interpret=True in each test) is what makes that pass actually compile
 something.
 
-Resolved lazily via module __getattr__: jax.default_backend() initializes
-(and freezes) the backend, and in this container the sitecustomize hook
-points the default platform at the single tunneled TPU — an import-time
-lookup would grab the chip as a side effect of merely importing this module
+A function, not a constant: jax.default_backend() initializes (and
+freezes) the backend, and in this container the sitecustomize hook points
+the default platform at the single tunneled TPU — an import-time constant
+would grab the chip as a side effect of merely importing this module
 outside a conftest-protected pytest run.
 """
 
 
-def __getattr__(name: str):
-    if name == "INTERPRET":
-        from mine_tpu.kernels import on_tpu_backend
-        return not on_tpu_backend()
-    raise AttributeError(name)
+def interpret() -> bool:
+    from mine_tpu.kernels import on_tpu_backend
+    return not on_tpu_backend()

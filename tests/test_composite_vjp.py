@@ -25,7 +25,7 @@ def xla_loss(rgb, sigma, xyz, z_mask, bg_inf, g_rgb, g_depth):
 
 def pallas_loss(rgb, sigma, xyz, z_mask, bg_inf, g_rgb, g_depth):
     out_rgb, out_depth = fused_volume_render_diff(rgb, sigma, xyz,
-                                                  z_mask, bg_inf, kernel_test_utils.INTERPRET)
+                                                  z_mask, bg_inf, kernel_test_utils.interpret())
     return jnp.sum(out_rgb * g_rgb) + jnp.sum(out_depth * g_depth)
 
 
@@ -56,7 +56,7 @@ def test_forward_values_match():
     ref_rgb, ref_depth, _, _ = rendering.plane_volume_rendering(
         rgb, sigma, xyz, False)
     out_rgb, out_depth = fused_volume_render_diff(rgb, sigma, xyz,
-                                                  False, False, kernel_test_utils.INTERPRET)
+                                                  False, False, kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(ref_rgb),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(out_depth), np.asarray(ref_depth),
@@ -67,7 +67,7 @@ def test_gradients_in_larger_volume():
     """More planes + non-uniform sigma exercise the suffix accumulator."""
     rgb, sigma, xyz = _volume(3, B=2, S=8, H=8, W=32)
     def loss_x(r, s, x):
-        o_rgb, o_d = fused_volume_render_diff(r, s, x, False, False, kernel_test_utils.INTERPRET)
+        o_rgb, o_d = fused_volume_render_diff(r, s, x, False, False, kernel_test_utils.interpret())
         return jnp.mean(o_rgb ** 2) + jnp.mean(o_d ** 2)
     def loss_ref(r, s, x):
         o_rgb, o_d, _, _ = rendering.plane_volume_rendering(r, s, x, False)

@@ -34,7 +34,7 @@ def test_fused_volume_render_matches_xla(bg_inf):
         rgb, sigma, xyz, bg_inf)
     out_rgb, out_depth = fused_volume_render(rgb, sigma, xyz,
                                              is_bg_depth_inf=bg_inf,
-                                             interpret=kernel_test_utils.INTERPRET)
+                                             interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(ref_rgb),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(out_depth), np.asarray(ref_depth),
@@ -50,7 +50,7 @@ def test_fused_volume_render_z_mask():
     ref_rgb, ref_depth, _, _ = rendering.plane_volume_rendering(
         rgb, masked_sigma, xyz, False)
     out_rgb, out_depth = fused_volume_render(rgb, sigma, xyz, z_mask=True,
-                                             interpret=kernel_test_utils.INTERPRET)
+                                             interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(ref_rgb),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(out_depth), np.asarray(ref_depth),
@@ -72,7 +72,7 @@ def test_fused_src_render_blend_matches_two_pass_xla():
         blended_ref, xyz, weights, False)
 
     out_rgb, out_depth, blended = fused_src_render_blend(
-        rgb, sigma, xyz, src, interpret=kernel_test_utils.INTERPRET)
+        rgb, sigma, xyz, src, interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(blended), np.asarray(blended_ref),
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(ref_rgb),

@@ -26,7 +26,7 @@ def test_matches_xla_bilinear_small_motion():
 
     ref = warp.bilinear_sample(jnp.asarray(src), jnp.asarray(x), jnp.asarray(y))
     out = pallas_bilinear_sample(jnp.asarray(src), jnp.asarray(x),
-                                 jnp.asarray(y), band=16, interpret=kernel_test_utils.INTERPRET)
+                                 jnp.asarray(y), band=16, interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 
@@ -43,7 +43,7 @@ def test_border_clamping_matches():
 
     ref = warp.bilinear_sample(jnp.asarray(src), jnp.asarray(x), jnp.asarray(y))
     out = pallas_bilinear_sample(jnp.asarray(src), jnp.asarray(x),
-                                 jnp.asarray(y), band=16, interpret=kernel_test_utils.INTERPRET)
+                                 jnp.asarray(y), band=16, interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 
@@ -73,7 +73,7 @@ def test_full_homography_warp_equivalence():
 
     ref, _ = warp.homography_warp(jnp.asarray(src), d, G, K_inv, K, grid)
     out = pallas_bilinear_sample(jnp.asarray(src), x, y, band=16,
-                                 interpret=kernel_test_utils.INTERPRET)
+                                 interpret=kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 

@@ -39,7 +39,7 @@ def test_forward_matches_gather():
     src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
     x, y = _mild_coords(rng, Bp, H, W)
     ref = warp.bilinear_sample(src, x, y)
-    out = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.INTERPRET)
+    out = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 
@@ -56,7 +56,7 @@ def test_grad_matches_gather_path():
         return jnp.sum(warp.bilinear_sample(s, x, y) * cot)
 
     def loss_ker(s):
-        return jnp.sum(bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.INTERPRET) * cot)
+        return jnp.sum(bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.interpret()) * cot)
 
     g_ref = jax.grad(loss_ref)(src)
     g_ker = jax.grad(loss_ker)(src)
@@ -77,7 +77,7 @@ def test_grad_with_border_clamping():
 
     g_ref = jax.grad(lambda s: jnp.sum(warp.bilinear_sample(s, x, y) * cot))(src)
     g_ker = jax.grad(lambda s: jnp.sum(
-        bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.INTERPRET) * cot))(src)
+        bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.interpret()) * cot))(src)
     np.testing.assert_allclose(np.asarray(g_ker), np.asarray(g_ref),
                                rtol=1e-4, atol=1e-4)
 
@@ -103,10 +103,10 @@ def test_guarded_fallback_is_exact():
 
     def loss_g(s):
         return jnp.sum(bilinear_sample_diff_guarded(
-            s, x, y, band=16, oband=16, interpret=kernel_test_utils.INTERPRET) * cot)
+            s, x, y, band=16, oband=16, interpret=kernel_test_utils.interpret()) * cot)
 
     out = bilinear_sample_diff_guarded(src, x, y, band=16, oband=16,
-                                       interpret=kernel_test_utils.INTERPRET)
+                                       interpret=kernel_test_utils.interpret())
     ref = warp.bilinear_sample(src, x, y)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
@@ -127,7 +127,7 @@ def test_guarded_fast_path_under_jit():
     @jax.jit
     def f(s):
         return jnp.sum(bilinear_sample_diff_guarded(
-            s, x, y, band=16, oband=16, interpret=kernel_test_utils.INTERPRET) * cot)
+            s, x, y, band=16, oband=16, interpret=kernel_test_utils.interpret()) * cot)
 
     v, g = jax.value_and_grad(f)(src)
     v_ref = jnp.sum(warp.bilinear_sample(src, x, y) * cot)
@@ -148,15 +148,15 @@ def test_bf16_mxu_variant_close_to_f32():
     x, y = _mild_coords(rng, Bp, H, W)
     cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
 
-    out32 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.INTERPRET, jnp.float32)
-    out16 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.INTERPRET, jnp.bfloat16)
+    out32 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.float32)
+    out16 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.bfloat16)
     np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
                                rtol=0.05, atol=0.03)
 
     g32 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
-        s, x, y, 16, 16, 8, kernel_test_utils.INTERPRET, jnp.float32) * cot))(src)
+        s, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.float32) * cot))(src)
     g16 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
-        s, x, y, 16, 16, 8, kernel_test_utils.INTERPRET, jnp.bfloat16) * cot))(src)
+        s, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.bfloat16) * cot))(src)
     np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
                                rtol=0.05, atol=0.05)
 
@@ -170,5 +170,5 @@ def test_coord_cotangents_are_zero():
     x, y = _mild_coords(rng, Bp, H, W)
 
     gx = jax.grad(lambda xx: jnp.sum(
-        bilinear_sample_diff(src, xx, y, 16, 16, 8, kernel_test_utils.INTERPRET)))(x)
+        bilinear_sample_diff(src, xx, y, 16, 16, 8, kernel_test_utils.interpret())))(x)
     assert float(jnp.max(jnp.abs(gx))) == 0.0

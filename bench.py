@@ -47,14 +47,19 @@ NUM_LAYERS = 18 if SMOKE else 50
 WARMUP_STEPS = 1 if SMOKE else 3
 MEASURE_STEPS = 2 if SMOKE else 20
 
-# name -> (batch, warp_backend, composite_backend, warp_dtype)
+# name -> (batch, config overrides)
 VARIANTS = {
-    "xla_b2": (2, "xla", "xla", "float32"),
-    "xla_b4": (4, "xla", "xla", "float32"),
-    "xla_b8": (8, "xla", "xla", "float32"),
-    "pallas_b2": (2, "pallas_diff", "pallas_diff", "float32"),
-    "pallas_b4": (4, "pallas_diff", "pallas_diff", "float32"),
-    "pallas_bf16_b4": (4, "pallas_diff", "pallas_diff", "bfloat16"),
+    "xla_b2": (2, {}),
+    "xla_b4": (4, {}),
+    "xla_b8": (8, {}),
+    "xla_b8_remat": (8, {"training.remat": "dots"}),
+    "pallas_b2": (2, {"training.warp_backend": "pallas_diff",
+                      "training.composite_backend": "pallas_diff"}),
+    "pallas_b4": (4, {"training.warp_backend": "pallas_diff",
+                      "training.composite_backend": "pallas_diff"}),
+    "pallas_bf16_b4": (4, {"training.warp_backend": "pallas_diff",
+                           "training.composite_backend": "pallas_diff",
+                           "training.warp_dtype": "bfloat16"}),
 }
 
 
@@ -117,14 +122,10 @@ def main():
     results = {}
     best_name, best_ips = None, 0.0
     for name in names:
-        batch, warp_be, comp_be, warp_dt = VARIANTS[name]
+        batch, overrides = VARIANTS[name]
         config = dict(base)
-        config.update({
-            "data.per_gpu_batch_size": batch,
-            "training.warp_backend": warp_be,
-            "training.composite_backend": comp_be,
-            "training.warp_dtype": warp_dt,
-        })
+        config["data.per_gpu_batch_size"] = batch
+        config.update(overrides)
         try:
             ips, _ = _measure(config, batch)
         except Exception as e:  # compile failure / OOM: record, continue
@@ -151,14 +152,10 @@ def main():
 
     if profile_dir:
         # re-run the winner fresh (the sweep retains no device state)
-        batch, warp_be, comp_be, warp_dt = VARIANTS[best_name]
+        batch, overrides = VARIANTS[best_name]
         config = dict(base)
-        config.update({
-            "data.per_gpu_batch_size": batch,
-            "training.warp_backend": warp_be,
-            "training.composite_backend": comp_be,
-            "training.warp_dtype": warp_dt,
-        })
+        config["data.per_gpu_batch_size"] = batch
+        config.update(overrides)
         _, run = _measure(config, batch, steps=1, keep_run=True)
         jax.profiler.start_trace(profile_dir)
         run(5)

@@ -31,6 +31,30 @@ from mine_tpu.train.loss import compute_losses
 from mine_tpu.train.state import TrainState, create_train_state, make_optimizer
 
 
+def _remat_policy(value):
+    """training.remat -> (enabled, jax.checkpoint policy).
+
+    false/"none": no remat; true/"full": save nothing (recompute the whole
+    model forward in backward); "dots": save MXU results (recompute only
+    elementwise work — the usual TPU sweet spot); "dots_no_batch": the
+    variant excluding batch dims (finer-grained memory saving).
+    """
+    if value in (False, None, "none", "false"):
+        return False, None
+    if value in (True, "full", "true"):
+        return True, None  # jax.checkpoint default: save nothing
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch":
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if value not in policies:
+        raise ValueError(
+            f"training.remat must be false|true|dots|dots_no_batch, "
+            f"got {value!r}")
+    return True, policies[value]
+
+
 def sample_disparity(key: jax.Array, batch_size: int, cfg: MPIConfig) -> jnp.ndarray:
     """Coarse plane disparities for one step (synthesis_task._get_disparity_list
     :31-60): stratified per-bin samples, explicit bin edges when provided,
@@ -80,7 +104,8 @@ class SynthesisTrainer:
             sigma_dropout_rate=self.cfg.sigma_dropout_rate,
             dtype=dtype,
             mesh=mesh if (mesh is not None and mesh.size > 1) else None)
-        self.remat = bool(config.get("training.remat", False))
+        self.remat, self.remat_policy = _remat_policy(
+            config.get("training.remat", False))
         self.tx = make_optimizer(config, steps_per_epoch)
         self.lpips_params = lpips_params
 
@@ -140,12 +165,12 @@ class SynthesisTrainer:
 
     def _apply_model(self, params, batch_stats, img, disparity, train, drop_key):
         variables = {"params": params, "batch_stats": batch_stats}
-        apply = self.model.apply
         if self.remat and train:
             apply = jax.checkpoint(
                 lambda v, i, d: self.model.apply(
                     v, i, d, train=True, mutable=["batch_stats"],
-                    rngs={"dropout": drop_key}))
+                    rngs={"dropout": drop_key}),
+                policy=self.remat_policy)
             return apply(variables, img, disparity)
         if train:
             return self.model.apply(variables, img, disparity, train=True,

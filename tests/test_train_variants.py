@@ -49,19 +49,29 @@ def test_bg_depth_inf_dtu_mode_step():
 
 
 def test_remat_step_matches_no_remat():
-    """training.remat rematerializes the model in backward — same numbers."""
+    """training.remat rematerializes the model in backward — same numbers
+    for every checkpoint policy (false | true | dots | dots_no_batch)."""
     cfg = tiny_config()
     t_plain = SynthesisTrainer(cfg, steps_per_epoch=10)
-    cfg_r = dict(cfg)
-    cfg_r["training.remat"] = True
-    t_remat = SynthesisTrainer(cfg_r, steps_per_epoch=10)
-
     batch = to_jnp(make_batch(1, 64, 64, num_points=16))
     s0 = t_plain.init_state(batch_size=1)
-    s1 = t_remat.init_state(batch_size=1)
-    _, m0 = t_plain.train_step(s0, batch)
-    _, m1 = t_remat.train_step(s1, batch)
-    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]), rtol=1e-4)
+    s0_after, m0 = t_plain.train_step(s0, batch)
+    # post-step params exercise the policy-dependent BACKWARD pass (the
+    # forward loss alone cannot distinguish checkpoint policies)
+    p0_after = [np.array(x)
+                for x in jax.tree_util.tree_leaves(s0_after.params)]
+
+    for policy in (True, "dots", "dots_no_batch"):
+        cfg_r = dict(cfg)
+        cfg_r["training.remat"] = policy
+        t_remat = SynthesisTrainer(cfg_r, steps_per_epoch=10)
+        s1 = t_remat.init_state(batch_size=1)
+        s1_after, m1 = t_remat.train_step(s1, batch)
+        np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                                   rtol=1e-4, err_msg=str(policy))
+        for a, b in zip(jax.tree_util.tree_leaves(s1_after.params), p0_after):
+            np.testing.assert_allclose(np.asarray(a), b, rtol=1e-4,
+                                       atol=1e-6, err_msg=str(policy))
 
 
 def test_smoothness_terms_enabled():

@@ -9,10 +9,22 @@ ResNet-50 backbone, bfloat16 conv stacks (BASELINE.md / BASELINE.json:
 Sweeps a small variant grid — per-chip batch size and the Pallas kernel
 backends (training.warp_backend / composite_backend = pallas_diff, the
 banded warp + fused composite custom-VJP pairs) — and reports the FASTEST
-as the headline number. Every variant is isolated: a kernel that fails to
-compile or OOMs on device is recorded in the variants table and skipped,
-never fatal (the Pallas kernels are interpret-validated but this may be
-their first on-device compile; ROADMAP "Blocked on hardware").
+as the headline number.
+
+Every variant runs in its OWN SUBPROCESS under a watchdog. The axon tunnel
+serves one chip and a lost remote-compile request wedges the client forever
+with zero CPU/IO (observed rounds 1-2: the server-side grant goes stale and
+every later PJRT init blocks too). Isolation turns that failure mode into a
+recorded per-variant error instead of a driver hang:
+
+  * child touches INIT_OK after jax.devices() succeeds — if that never
+    appears the chip itself is wedged and the sweep aborts (remaining
+    variants would each eat the full timeout for nothing);
+  * a variant that compiles-then-hangs or OOMs is killed and recorded,
+    and the next variant still gets a fresh client;
+  * compiled executables persist across children via the JAX compilation
+    cache (MINE_TPU_BENCH_CACHE, default /root/.cache/jax_bench), so
+    subprocess isolation doesn't pay recompiles.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
@@ -29,11 +41,17 @@ Env knobs:
   MINE_TPU_BENCH_VARIANTS=a,b    run only the named variants
   MINE_TPU_BENCH_SMOKE=1         tiny shapes / few steps — harness self-test
                                  on CPU, NOT a benchmark
+  MINE_TPU_BENCH_INIT_TIMEOUT    seconds for child PJRT init (default 240)
+  MINE_TPU_BENCH_VARIANT_TIMEOUT seconds per variant incl. compile
+                                 (default 1800)
+  MINE_TPU_BENCH_CACHE           persistent compile-cache dir ('' disables)
 """
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 # Reference estimate: MINE on 2x V100 (B=2/GPU, fp32, 384x256, N=32).
@@ -46,6 +64,11 @@ PLANES = 4 if SMOKE else 32
 NUM_LAYERS = 18 if SMOKE else 50
 WARMUP_STEPS = 1 if SMOKE else 3
 MEASURE_STEPS = 2 if SMOKE else 20
+
+INIT_TIMEOUT = float(os.environ.get("MINE_TPU_BENCH_INIT_TIMEOUT",
+                                    60 if SMOKE else 240))
+VARIANT_TIMEOUT = float(os.environ.get("MINE_TPU_BENCH_VARIANT_TIMEOUT",
+                                       300 if SMOKE else 1800))
 
 # name -> (batch, config overrides)
 VARIANTS = {
@@ -63,12 +86,23 @@ VARIANTS = {
 }
 
 
-def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
-    """Compile + run one variant; returns (images_per_sec, run_fn|None).
+def _variant_config(name):
+    from mine_tpu.config import CONFIG_DIR, load_config
+    batch, overrides = VARIANTS[name]
+    config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+    config.update({
+        "data.img_h": HEIGHT, "data.img_w": WIDTH,
+        "mpi.num_bins_coarse": PLANES,
+        "model.num_layers": NUM_LAYERS,
+        "training.dtype": "float32" if SMOKE else "bfloat16",
+        "data.per_gpu_batch_size": batch,
+    })
+    config.update(overrides)
+    return config, batch
 
-    run_fn (for the profiler) pins the variant's state/executables in device
-    memory — only kept when requested, so earlier variants can't skew later
-    ones toward OOM."""
+
+def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
+    """Compile + run one variant; returns (images_per_sec, run_fn|None)."""
     import jax
     import jax.numpy as jnp
 
@@ -96,12 +130,130 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
     return batch_size * steps / dt, (run if keep_run else None)
 
 
+# ---------------------------------------------------------------- child
+
+def _child(name: str, outdir: str) -> None:
+    """Run one variant; touch INIT_OK after device init, write result.json."""
+    cache = os.environ.get("MINE_TPU_BENCH_CACHE", "/root/.cache/jax_bench")
+
+    def write(payload):
+        with open(os.path.join(outdir, "result.json.tmp"), "w") as f:
+            json.dump(payload, f)
+        os.replace(os.path.join(outdir, "result.json.tmp"),
+                   os.path.join(outdir, "result.json"))
+
+    try:
+        import jax
+        if SMOKE:
+            # smoke is a CPU harness self-test; never touch the chip (env
+            # var alone is overridden by the container's sitecustomize)
+            jax.config.update("jax_platforms", "cpu")
+        if cache:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.devices()  # blocks until the chip grant is acquired
+        open(os.path.join(outdir, "INIT_OK"), "w").close()
+
+        config, batch = _variant_config(name)
+        profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
+        # the profile re-run only needs `run`; don't pay a full measurement
+        ips, run = _measure(config, batch,
+                            steps=1 if profile_dir else MEASURE_STEPS,
+                            keep_run=bool(profile_dir))
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+            run(5)
+            jax.profiler.stop_trace()
+            print("profiler trace (%s) in %s" % (name, profile_dir),
+                  file=sys.stderr)
+        write({"ips": ips})
+    except Exception as e:  # compile failure / OOM: record for the parent
+        msg = (str(e).splitlines() or [repr(e)])[0][:200]
+        write({"error": msg})
+
+
+# ---------------------------------------------------------------- parent
+
+def run_child_watchdog(cmd, outdir, init_timeout, body_timeout, env=None):
+    """Supervise a child that touches INIT_OK then writes result.json.
+
+    Returns (payload|None, error|None, wedged). `wedged` is True only for a
+    genuine deadline expiry with the child still alive — a child that DIES
+    without writing a result (segfault, OOM-kill) is a per-run error, not a
+    chip wedge. Shared by bench.py and tools/tpu_escalate.py.
+    """
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL, env=env)
+    init_path = os.path.join(outdir, "INIT_OK")
+    result_path = os.path.join(outdir, "result.json")
+
+    def wait_for(path, deadline):
+        """'found' | 'died' | 'timeout' (re-checks path after child exit)."""
+        while True:
+            if os.path.exists(path):
+                return "found"
+            if proc.poll() is not None:
+                # give the filesystem a beat, then re-check once
+                time.sleep(0.2)
+                return "found" if os.path.exists(path) else "died"
+            if time.time() >= deadline:
+                return "timeout"
+            time.sleep(0.5)
+
+    def read_result():
+        with open(result_path) as f:
+            return json.load(f)
+
+    status = wait_for(init_path, time.time() + init_timeout)
+    if status != "found":
+        proc.kill()
+        proc.wait()
+        if os.path.exists(result_path):  # child recorded its own error
+            return None, read_result().get("error", "child died"), False
+        if status == "died":
+            return None, ("child died before device init "
+                          "(rc=%s)" % proc.returncode), False
+        return (None, "init timeout after %ds (chip wedged?)" % init_timeout,
+                True)
+
+    status = wait_for(result_path, time.time() + body_timeout)
+    if status != "found":
+        proc.kill()
+        proc.wait()
+        if status == "died":
+            return None, "child died mid-run (rc=%s)" % proc.returncode, False
+        return (None, "timeout after %ds (compile/run hang)" % body_timeout,
+                True)
+    proc.wait()
+    payload = read_result()
+    if "error" in payload:
+        return None, payload["error"], False
+    return payload, None, False
+
+
+def _run_variant(name: str, env_extra=None):
+    """Spawn the child for `name`; returns (ips|None, error|None, wedged)."""
+    outdir = tempfile.mkdtemp(prefix="bench_%s_" % name)
+    env = dict(os.environ)
+    env.pop("MINE_TPU_BENCH_PROFILE", None)
+    env.update(env_extra or {})
+    try:
+        payload, err, wedged = run_child_watchdog(
+            [sys.executable, os.path.abspath(__file__), "--child", name,
+             outdir],
+            outdir, INIT_TIMEOUT, VARIANT_TIMEOUT, env=env)
+    finally:
+        import shutil
+        shutil.rmtree(outdir, ignore_errors=True)
+    if payload is None:
+        return None, err, wedged
+    return payload["ips"], None, False
+
+
 def main():
-    import jax
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2], sys.argv[3])
+        return
 
-    from mine_tpu.config import CONFIG_DIR, load_config
-
-    profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
     only = os.environ.get("MINE_TPU_BENCH_VARIANTS")
     names = [n.strip() for n in only.split(",") if n.strip()] if only \
         else list(VARIANTS)
@@ -111,28 +263,20 @@ def main():
               % (unknown, sorted(VARIANTS)), file=sys.stderr)
         sys.exit(2)
 
-    base = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
-    base.update({
-        "data.img_h": HEIGHT, "data.img_w": WIDTH,
-        "mpi.num_bins_coarse": PLANES,
-        "model.num_layers": NUM_LAYERS,
-        "training.dtype": "float32" if SMOKE else "bfloat16",
-    })
-
     results = {}
     best_name, best_ips = None, 0.0
-    for name in names:
-        batch, overrides = VARIANTS[name]
-        config = dict(base)
-        config["data.per_gpu_batch_size"] = batch
-        config.update(overrides)
-        try:
-            ips, _ = _measure(config, batch)
-        except Exception as e:  # compile failure / OOM: record, continue
-            msg = (str(e).splitlines() or [repr(e)])[0][:200]
-            results[name] = "error: %s" % msg
-            print("variant %s failed: %s" % (name, results[name]),
+    for i, name in enumerate(names):
+        ips, err, wedged = _run_variant(name)
+        if wedged:
+            results[name] = "error: " + err
+            for rest in names[i + 1:]:
+                results[rest] = "skipped: chip wedged"
+            print("variant %s: %s — aborting sweep" % (name, err),
                   file=sys.stderr)
+            break
+        if err is not None:
+            results[name] = "error: " + err
+            print("variant %s failed: %s" % (name, err), file=sys.stderr)
             continue
         results[name] = round(ips, 3)
         print("variant %s: %.3f images/sec" % (name, ips), file=sys.stderr)
@@ -150,18 +294,14 @@ def main():
             "variants": results, "error": "all variants failed"}))
         sys.exit(1)
 
+    profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
     if profile_dir:
-        # re-run the winner fresh (the sweep retains no device state)
-        batch, overrides = VARIANTS[best_name]
-        config = dict(base)
-        config["data.per_gpu_batch_size"] = batch
-        config.update(overrides)
-        _, run = _measure(config, batch, steps=1, keep_run=True)
-        jax.profiler.start_trace(profile_dir)
-        run(5)
-        jax.profiler.stop_trace()
-        print("profiler trace (winner=%s) in %s" % (best_name, profile_dir),
-              file=sys.stderr)
+        # re-run the winner in a fresh child with profiling enabled (the
+        # sweep's children are gone; the compile cache makes this cheap)
+        _, err, _ = _run_variant(best_name,
+                                 {"MINE_TPU_BENCH_PROFILE": profile_dir})
+        if err:
+            print("profile re-run failed: %s" % err, file=sys.stderr)
 
     result = {
         "metric": metric,

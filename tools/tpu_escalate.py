@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Staged TPU first-contact: find which program size wedges the chip.
+
+The axon tunnel's remote compile can lose a request and wedge both the
+client and the server-side grant (rounds 1-2; ROADMAP.md). After the grant
+clears, do NOT jump straight to the full benchmark — walk up this ladder,
+one subprocess per stage (a wedged stage then costs one timeout and leaves
+a diagnosis, not a dead round):
+
+  init      PJRT init only (jax.devices())
+  matmul    jit 1024x1024 bf16 matmul
+  conv      jit ResNet-50 encoder forward, B=2 256x384
+  step18    full train step, resnet18 128x128 S=8 B=1
+  pallas    banded warp kernel compiled on device, tiny shapes
+  step50    full train step at the bench config (== bench.py xla_b2)
+
+Supervision (INIT_OK sentinel, result.json, wedge-vs-crash triage) and the
+persistent compile cache are shared with bench.py, so the ladder's
+successful compiles are exactly the ones the benchmark will reuse.
+Usage: python tools/tpu_escalate.py [stage ...] (default: all).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = ["init", "matmul", "conv", "step18", "pallas", "step50"]
+TIMEOUTS = {"init": 240, "matmul": 420, "conv": 900, "step18": 1200,
+            "pallas": 900, "step50": 1800}
+
+
+def _stage_body(stage: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if stage == "init":
+        pass
+    elif stage == "matmul":
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    elif stage == "conv":
+        from mine_tpu.models.resnet import ResnetEncoder
+        m = ResnetEncoder(num_layers=50, dtype=jnp.bfloat16)
+        img = jnp.zeros((2, 256, 384, 3), jnp.float32)
+        vars_ = jax.jit(lambda: m.init(jax.random.PRNGKey(0), img,
+                                       train=False))()
+        out = jax.jit(lambda v, i: m.apply(v, i, train=False))(vars_, img)
+        jax.block_until_ready(out)
+    elif stage in ("step18", "step50"):
+        import bench
+        from mine_tpu.data.synthetic import make_batch
+        from mine_tpu.train.step import SynthesisTrainer
+        if stage == "step50":
+            # byte-identical to the benchmark's xla_b2 variant
+            config, B = bench._variant_config("xla_b2")
+            H, W = bench.HEIGHT, bench.WIDTH
+        else:
+            from mine_tpu.config import CONFIG_DIR, load_config
+            config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+            config.update({"data.img_h": 128, "data.img_w": 128,
+                           "mpi.num_bins_coarse": 8, "model.num_layers": 18,
+                           "training.dtype": "bfloat16",
+                           "data.per_gpu_batch_size": 1})
+            B, H, W = 1, 128, 128
+        trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+        state = trainer.init_state(batch_size=B)
+        batch = {k: jnp.asarray(v) for k, v in
+                 make_batch(B, H, W, num_points=256).items()}
+        state, metrics = trainer.train_step(state, batch)
+        jax.block_until_ready(metrics)
+    elif stage == "pallas":
+        from mine_tpu.kernels.warp import pallas_bilinear_sample
+        src = jnp.ones((4, 7, 64, 128), jnp.float32)
+        yy, xx = jnp.meshgrid(jnp.arange(64.0), jnp.arange(128.0),
+                              indexing="ij")
+        cx = jnp.broadcast_to(xx[None] + 0.3, (4, 64, 128))
+        cy = jnp.broadcast_to(yy[None] + 0.2, (4, 64, 128))
+        out = pallas_bilinear_sample(src, cx, cy, band=16, interpret=False)
+        jax.block_until_ready(out)
+    else:
+        raise ValueError(stage)
+
+
+def _child(stage: str, outdir: str) -> None:
+    def write(payload):
+        with open(os.path.join(outdir, "result.json.tmp"), "w") as f:
+            json.dump(payload, f)
+        os.replace(os.path.join(outdir, "result.json.tmp"),
+                   os.path.join(outdir, "result.json"))
+
+    try:
+        import jax
+        cache = os.environ.get("MINE_TPU_BENCH_CACHE",
+                               "/root/.cache/jax_bench")
+        if cache:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+        t0 = time.time()
+        devs = jax.devices()
+        open(os.path.join(outdir, "INIT_OK"), "w").close()
+        print("[%s] init ok %.1fs %s" % (stage, time.time() - t0, devs),
+              file=sys.stderr)
+
+        t0 = time.time()
+        _stage_body(stage)
+        dt = time.time() - t0
+        write({"ok": True, "seconds": round(dt, 2)})
+        print("[%s] ran in %.1fs" % (stage, dt), file=sys.stderr)
+    except Exception as e:  # a plain bug is a recorded error, not a wedge
+        msg = (str(e).splitlines() or [repr(e)])[0][:200]
+        write({"error": msg})
+
+
+def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2], sys.argv[3])
+        return
+
+    import shutil
+
+    import bench
+
+    stages = sys.argv[1:] or STAGES
+    unknown = [s for s in stages if s not in STAGES]
+    if unknown:
+        print("unknown stages %s (known %s)" % (unknown, STAGES))
+        sys.exit(2)
+
+    report = {}
+    for stage in stages:
+        outdir = tempfile.mkdtemp(prefix="escalate_%s_" % stage)
+        try:
+            payload, err, wedged = bench.run_child_watchdog(
+                [sys.executable, os.path.abspath(__file__), "--child", stage,
+                 outdir],
+                outdir, TIMEOUTS["init"], TIMEOUTS[stage])
+        finally:
+            shutil.rmtree(outdir, ignore_errors=True)
+        if payload is not None:
+            report[stage] = payload
+        else:
+            report[stage] = {"ok": False, "error": err, "wedged": wedged}
+        print("stage %s: %s" % (stage, report[stage]), file=sys.stderr)
+        if wedged:
+            print("stage %s WEDGED — stopping ladder" % stage,
+                  file=sys.stderr)
+            break
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+#!/bin/bash
+# TPU grant probe loop: the axon tunnel serves ONE chip; a dead client can
+# leave its server-side grant stale, wedging every new client's PJRT init
+# (observed round 1 and round 2 — see ROADMAP.md). The grant does expire:
+# probe until init succeeds, then STOP (holding the success process would
+# itself hold the grant).
+#
+# Usage: tools/tpu_probe.sh [interval_s] [timeout_s]  (defaults 300 170)
+# Appends one line per attempt to /tmp/tpu_probe_history.log; on success
+# writes /tmp/tpu_alive and exits.
+INTERVAL=${1:-300}
+TIMEOUT=${2:-170}
+LOG=/tmp/tpu_probe_history.log
+rm -f /tmp/tpu_alive
+while true; do
+  t0=$(date +%s)
+  out=$(timeout "$TIMEOUT" python -c "import jax; print(jax.devices())" 2>&1)
+  rc=$?   # timeout's own status: 124 = timed out, 0 = init succeeded
+  last=$(printf '%s' "$out" | tail -1)
+  echo "$(date -Is) rc=$rc dt=$(( $(date +%s) - t0 ))s ${last:0:120}" >> "$LOG"
+  if [ "$rc" -eq 0 ]; then
+    touch /tmp/tpu_alive
+    echo "$(date -Is) ALIVE — stopping probe" >> "$LOG"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

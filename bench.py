@@ -132,15 +132,29 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
 
 # ---------------------------------------------------------------- child
 
+def write_result(outdir, payload):
+    """Atomic result.json write — the watchdog protocol's child half.
+    Shared by bench.py, tools/tpu_escalate.py, tools/microbench.py."""
+    with open(os.path.join(outdir, "result.json.tmp"), "w") as f:
+        json.dump(payload, f)
+    os.replace(os.path.join(outdir, "result.json.tmp"),
+               os.path.join(outdir, "result.json"))
+
+
+def configure_cache():
+    """Point JAX at the shared persistent compile cache (the escalate
+    ladder's compiles are exactly the ones the benchmark reuses)."""
+    import jax
+    cache = os.environ.get("MINE_TPU_BENCH_CACHE", "/root/.cache/jax_bench")
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
 def _child(name: str, outdir: str) -> None:
     """Run one variant; touch INIT_OK after device init, write result.json."""
-    cache = os.environ.get("MINE_TPU_BENCH_CACHE", "/root/.cache/jax_bench")
-
     def write(payload):
-        with open(os.path.join(outdir, "result.json.tmp"), "w") as f:
-            json.dump(payload, f)
-        os.replace(os.path.join(outdir, "result.json.tmp"),
-                   os.path.join(outdir, "result.json"))
+        write_result(outdir, payload)
 
     try:
         import jax
@@ -148,9 +162,7 @@ def _child(name: str, outdir: str) -> None:
             # smoke is a CPU harness self-test; never touch the chip (env
             # var alone is overridden by the container's sitecustomize)
             jax.config.update("jax_platforms", "cpu")
-        if cache:
-            jax.config.update("jax_compilation_cache_dir", cache)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        configure_cache()
         jax.devices()  # blocks until the chip grant is acquired
         open(os.path.join(outdir, "INIT_OK"), "w").close()
 
@@ -219,10 +231,17 @@ def run_child_watchdog(cmd, outdir, init_timeout, body_timeout, env=None):
     if status != "found":
         proc.kill()
         proc.wait()
+        if os.path.exists(result_path):  # landed in the last poll window
+            payload = read_result()
+            if "error" in payload:
+                return None, payload["error"], False
+            return payload, None, False
         if status == "died":
             return None, "child died mid-run (rc=%s)" % proc.returncode, False
+        # not flagged as a wedge: the NEXT child's init either succeeds (the
+        # hang was variant-specific) or trips the init timeout (truly wedged)
         return (None, "timeout after %ds (compile/run hang)" % body_timeout,
-                True)
+                False)
     proc.wait()
     payload = read_result()
     if "error" in payload:

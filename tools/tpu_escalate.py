@@ -87,19 +87,14 @@ def _stage_body(stage: str) -> None:
 
 
 def _child(stage: str, outdir: str) -> None:
+    import bench
+
     def write(payload):
-        with open(os.path.join(outdir, "result.json.tmp"), "w") as f:
-            json.dump(payload, f)
-        os.replace(os.path.join(outdir, "result.json.tmp"),
-                   os.path.join(outdir, "result.json"))
+        bench.write_result(outdir, payload)
 
     try:
         import jax
-        cache = os.environ.get("MINE_TPU_BENCH_CACHE",
-                               "/root/.cache/jax_bench")
-        if cache:
-            jax.config.update("jax_compilation_cache_dir", cache)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        bench.configure_cache()
 
         t0 = time.time()
         devs = jax.devices()

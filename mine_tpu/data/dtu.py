@@ -37,6 +37,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 from PIL import Image as PILImage
 
+from mine_tpu import native
+
 # the customary DTU evaluation view subset (MVS protocol) dropped when
 # data.is_exclude_views is set
 EVAL_VIEWS = (3, 13, 23, 33, 43)
@@ -160,10 +162,9 @@ class DTUDataset:
 
     def _view_info(self, scan: str, view: int, light: str) -> Dict:
         path = self.scans[scan][view][light]
-        pil = PILImage.open(path).convert("RGB")
-        w0, h0 = pil.size
-        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
-        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        with PILImage.open(path) as pil:  # header-only size read
+            w0, h0 = pil.size
+        img = native.load_image_rgb(path, (self.img_w, self.img_h))
         K = self.cams[view]["intrinsic"] * self.intrinsics_scale
         K[2, 2] = 1.0
         K[0] *= self.img_w / w0

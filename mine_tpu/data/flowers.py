@@ -28,6 +28,8 @@ from typing import Dict, Iterator, Optional, Tuple
 import numpy as np
 from PIL import Image as PILImage
 
+from mine_tpu import native
+
 
 def parse_cam_params(path: str) -> Dict[Tuple[int, int], Dict[str, np.ndarray]]:
     """cam_params.txt -> {(r, c): {intrinsics[4], pose[3,4]}}.
@@ -106,9 +108,7 @@ class FlowersDataset:
         u, v = rc[0] + self.offset, rc[1] + self.offset
         view = np.ascontiguousarray(
             extract_subaperture(eslf, u, v, self.stride))
-        pil = PILImage.fromarray(view)
-        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
-        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        img = native.resize_rgb_u8(view, (self.img_w, self.img_h))
 
         cam = self.cams[rc]
         fx, fy, cx, cy = (float(x) for x in cam["intrinsics"])

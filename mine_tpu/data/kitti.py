@@ -31,6 +31,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 from PIL import Image as PILImage
 
+from mine_tpu import native
+
 
 def parse_calib_cam_to_cam(path: str) -> Dict[str, np.ndarray]:
     """calib_cam_to_cam.txt -> {key: array} (P_rect_02/03 as [3,4],
@@ -112,9 +114,8 @@ class KITTIRawDataset:
         return len(self.items)
 
     def _load(self, path: str) -> np.ndarray:
-        pil = PILImage.open(path).convert("RGB")
-        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
-        return np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        # native decode+resize (C++ libjpeg/libpng; PIL-parity fallback)
+        return native.load_image_rgb(path, (self.img_w, self.img_h))
 
     def get_item(self, index: int, rng: np.random.RandomState):
         lp, rp, K, baseline = self.items[index]

@@ -31,6 +31,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 from PIL import Image as PILImage
 
+from mine_tpu import native
 from mine_tpu.data import colmap
 
 
@@ -75,10 +76,10 @@ class LLFFDataset:
                 if not os.path.exists(img_path):
                     continue
 
-                pil = PILImage.open(img_path).convert("RGB")
-                w, h = pil.size
-                pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
-                img = np.asarray(pil, dtype=np.float32) / 255.0  # HWC [0,1]
+                with PILImage.open(img_path) as pil:  # header-only size read
+                    w, h = pil.size
+                img = native.load_image_rgb(
+                    img_path, (self.img_w, self.img_h))  # HWC [0,1]
 
                 ratio_x = w * pre_ratio / self.img_w
                 ratio_y = h * pre_ratio / self.img_h

@@ -42,7 +42,8 @@ import os
 from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
-from PIL import Image as PILImage
+
+from mine_tpu import native
 
 _FRAME_EXTS = (".png", ".jpg", ".jpeg")
 
@@ -216,9 +217,7 @@ class RealEstate10KDataset:
         if img is not None:
             self._img_cache.move_to_end(path)
             return img
-        pil = PILImage.open(path).convert("RGB")
-        pil = pil.resize((self.img_w, self.img_h), PILImage.BICUBIC)
-        img = np.ascontiguousarray(np.asarray(pil, np.float32) / 255.0)
+        img = native.load_image_rgb(path, (self.img_w, self.img_h))
         self._img_cache[path] = img
         while len(self._img_cache) > self._cache_frames:
             self._img_cache.popitem(last=False)

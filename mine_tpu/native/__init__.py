@@ -1,0 +1,140 @@
+"""Native (C++) data-IO with a transparent PIL fallback.
+
+The reference's image pipeline rides torch DataLoader worker processes
+(train.py:88-99) — native decode via PIL's libjpeg, parallelism via
+fork+pickle. Here the native path is in-process C++ (native/dataio.cpp):
+libjpeg/libpng decode, PIL-compatible filtered-bicubic resize, and a
+C++ thread pool for batches — no GIL, no worker processes. Loaders call
+`load_image_rgb` / `load_batch_rgb` and never know which backend ran:
+
+  * if `libmtio.so` exists (built with `python -m mine_tpu.native.build`),
+    the C++ path runs;
+  * otherwise PIL, bit-compatible to within uint8 rounding
+    (tests/test_native_io.py gates both paths against each other).
+
+Set MINE_TPU_NATIVE_IO=0 to force the PIL path (e.g. to triage a decode
+difference).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libmtio.so")
+_lib = None
+_lib_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("MINE_TPU_NATIVE_IO") == "0":
+        return None
+    if not os.path.exists(_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.mtio_load_resize.restype = ctypes.c_int
+    lib.mtio_load_resize.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.mtio_load_resize_batch.restype = None
+    lib.mtio_load_resize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.mtio_resize_u8.restype = ctypes.c_int
+    lib.mtio_resize_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the C++ library is built and loadable."""
+    return _load() is not None
+
+
+def _pil_load(path: str, size: Tuple[int, int]) -> np.ndarray:
+    from PIL import Image as PILImage
+    pil = PILImage.open(path).convert("RGB")
+    pil = pil.resize(size, PILImage.BICUBIC)
+    return np.asarray(pil, dtype=np.float32) / 255.0
+
+
+def load_image_rgb(path: str, size: Tuple[int, int]) -> np.ndarray:
+    """Decode + bicubic-resize to `size` (w, h): float32 HWC RGB in [0,1].
+
+    The shared image path of every dataset loader (the decode half of
+    nerf_dataset.py:79-81's cache fill). C++ when built, PIL otherwise.
+    """
+    w, h = size
+    lib = _load()
+    if lib is None:
+        return _pil_load(path, size)
+    out = np.empty((h, w, 3), np.float32)
+    rc = lib.mtio_load_resize(
+        os.fspath(path).encode(), w, h,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc != 0:  # undecodable by the native path — let PIL raise/handle
+        return _pil_load(path, size)
+    return out
+
+
+def load_batch_rgb(paths: Sequence[str], size: Tuple[int, int],
+                   num_threads: int = 0) -> np.ndarray:
+    """Decode + resize a batch: float32 [N, h, w, 3] in [0,1].
+
+    C++ thread-pool when built (num_threads<=0: one per CPU); sequential
+    PIL otherwise.
+    """
+    w, h = size
+    n = len(paths)
+    lib = _load()
+    if lib is None or n == 0:
+        return np.stack([_pil_load(p, size) for p in paths]) if n else \
+            np.empty((0, h, w, 3), np.float32)
+    if num_threads <= 0:
+        num_threads = os.cpu_count() or 1
+    out = np.empty((n, h, w, 3), np.float32)
+    rcs = np.zeros(n, np.int32)
+    arr = (ctypes.c_char_p * n)(*[os.fspath(p).encode() for p in paths])
+    lib.mtio_load_resize_batch(
+        arr, n, w, h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        num_threads, rcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    for i in np.nonzero(rcs)[0]:
+        out[i] = _pil_load(paths[i], size)  # per-item fallback
+    return out
+
+
+def resize_rgb_u8(img: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
+    """Bicubic-resize a uint8 HWC RGB array: float32 [h, w, 3] in [0,1].
+
+    For loaders that crop before resizing (e.g. the flowers lenslet grid).
+    """
+    assert img.dtype == np.uint8 and img.ndim == 3 and img.shape[2] == 3, \
+        img.shape
+    w, h = size
+    lib = _load()
+    if lib is None:
+        from PIL import Image as PILImage
+        pil = PILImage.fromarray(img).resize(size, PILImage.BICUBIC)
+        return np.asarray(pil, dtype=np.float32) / 255.0
+    img = np.ascontiguousarray(img)
+    out = np.empty((h, w, 3), np.float32)
+    rc = lib.mtio_resize_u8(
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        img.shape[1], img.shape[0], w, h,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    assert rc == 0, rc
+    return out

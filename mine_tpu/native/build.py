@@ -1,0 +1,31 @@
+"""Build the native data-IO library: `python -m mine_tpu.native.build`.
+
+One translation unit, no build system needed — g++ -O3 -shared against the
+libjpeg/libpng the image ships. The wrapper (mine_tpu.native) loads the
+resulting .so from this directory and silently falls back to PIL when it is
+absent, so building is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "dataio.cpp")
+OUT = os.path.join(HERE, "libmtio.so")
+
+
+def build(verbose: bool = True) -> str:
+    """Compile dataio.cpp -> libmtio.so; returns the .so path."""
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           SRC, "-o", OUT, "-ljpeg", "-lpng", "-lz"]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()

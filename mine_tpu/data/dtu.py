@@ -35,7 +35,6 @@ import re
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
-from PIL import Image as PILImage
 
 from mine_tpu import native
 
@@ -162,9 +161,8 @@ class DTUDataset:
 
     def _view_info(self, scan: str, view: int, light: str) -> Dict:
         path = self.scans[scan][view][light]
-        with PILImage.open(path) as pil:  # header-only size read
-            w0, h0 = pil.size
-        img = native.load_image_rgb(path, (self.img_w, self.img_h))
+        img, (w0, h0) = native.load_image_rgb(
+            path, (self.img_w, self.img_h), with_src_size=True)
         K = self.cams[view]["intrinsic"] * self.intrinsics_scale
         K[2, 2] = 1.0
         K[0] *= self.img_w / w0

@@ -29,7 +29,6 @@ import os
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
-from PIL import Image as PILImage
 
 from mine_tpu import native
 from mine_tpu.data import colmap
@@ -62,6 +61,12 @@ class LLFFDataset:
         self.scene_of: List[str] = []
         self.scene_to_indices: Dict[str, List[int]] = {}
 
+        # two-phase cache fill: collect every image path + its metadata
+        # first, then decode through the threaded native batch loader
+        # (mine_tpu.native; sequential PIL when not built) in bounded
+        # chunks — peak RAM stays dataset + one chunk, and the decode also
+        # reports each image's pre-resize size (no separate header probe)
+        records = []  # (scene, img_path, item, camera, points3d)
         for scene_name in sorted(os.listdir(root)):
             scene_dir = os.path.join(root, scene_name)
             sparse = os.path.join(scene_dir, "sparse/0")
@@ -75,17 +80,23 @@ class LLFFDataset:
                 img_path = os.path.join(scene_dir, image_folder, item.name)
                 if not os.path.exists(img_path):
                     continue
+                records.append((scene_name, img_path, item,
+                                cameras[item.camera_id], points3d))
 
-                with PILImage.open(img_path) as pil:  # header-only size read
-                    w, h = pil.size
-                img = native.load_image_rgb(
-                    img_path, (self.img_w, self.img_h))  # HWC [0,1]
-
-                ratio_x = w * pre_ratio / self.img_w
-                ratio_y = h * pre_ratio / self.img_h
-
-                info = self._build_info(item, cameras[item.camera_id],
-                                        points3d, img, (ratio_x, ratio_y))
+        CHUNK = 64
+        for c0 in range(0, len(records), CHUNK):
+            chunk = records[c0:c0 + CHUNK]
+            imgs, dims = native.load_batch_rgb(
+                [r[1] for r in chunk], (self.img_w, self.img_h),
+                with_src_sizes=True)
+            for (scene_name, img_path, item, camera, points3d), img, (w, h) \
+                    in zip(chunk, imgs, dims):
+                ratios = (w * pre_ratio / self.img_w,
+                          h * pre_ratio / self.img_h)
+                # copy: `img` is a view into the chunk batch — the cache
+                # must not pin the whole chunk per kept image
+                info = self._build_info(item, camera, points3d, img.copy(),
+                                        ratios)
                 if info is None:
                     continue
                 assert info["xyzs"].shape[1] >= visible_points_count, (

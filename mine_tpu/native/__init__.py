@@ -46,12 +46,13 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.mtio_load_resize.restype = ctypes.c_int
     lib.mtio_load_resize.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_float)]
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.mtio_load_resize_batch.restype = None
     lib.mtio_load_resize_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_int,
-        ctypes.POINTER(ctypes.c_int)]
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.mtio_resize_u8.restype = ctypes.c_int
     lib.mtio_resize_u8.argtypes = [
         ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int, ctypes.c_int,
@@ -65,56 +66,70 @@ def available() -> bool:
     return _load() is not None
 
 
-def _pil_load(path: str, size: Tuple[int, int]) -> np.ndarray:
+def _pil_load(path: str, size: Tuple[int, int]) -> Tuple[np.ndarray,
+                                                         Tuple[int, int]]:
     from PIL import Image as PILImage
     pil = PILImage.open(path).convert("RGB")
+    src_size = pil.size
     pil = pil.resize(size, PILImage.BICUBIC)
-    return np.asarray(pil, dtype=np.float32) / 255.0
+    return np.asarray(pil, dtype=np.float32) / 255.0, src_size
 
 
-def load_image_rgb(path: str, size: Tuple[int, int]) -> np.ndarray:
+def load_image_rgb(path: str, size: Tuple[int, int],
+                   with_src_size: bool = False):
     """Decode + bicubic-resize to `size` (w, h): float32 HWC RGB in [0,1].
 
     The shared image path of every dataset loader (the decode half of
     nerf_dataset.py:79-81's cache fill). C++ when built, PIL otherwise.
+    With `with_src_size` returns (img, (src_w, src_h)) — one file open
+    serves loaders that rescale intrinsics by the original size.
     """
     w, h = size
     lib = _load()
     if lib is None:
-        return _pil_load(path, size)
+        img, src = _pil_load(path, size)
+        return (img, src) if with_src_size else img
     out = np.empty((h, w, 3), np.float32)
+    sw, sh = ctypes.c_int(0), ctypes.c_int(0)
     rc = lib.mtio_load_resize(
-        os.fspath(path).encode(), w, h,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        os.fsencode(path), w, h,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(sw), ctypes.byref(sh))
     if rc != 0:  # undecodable by the native path — let PIL raise/handle
-        return _pil_load(path, size)
-    return out
+        img, src = _pil_load(path, size)
+        return (img, src) if with_src_size else img
+    return (out, (sw.value, sh.value)) if with_src_size else out
 
 
 def load_batch_rgb(paths: Sequence[str], size: Tuple[int, int],
-                   num_threads: int = 0) -> np.ndarray:
+                   num_threads: int = 0,
+                   with_src_sizes: bool = False):
     """Decode + resize a batch: float32 [N, h, w, 3] in [0,1].
 
     C++ thread-pool when built (num_threads<=0: one per CPU); sequential
-    PIL otherwise.
+    PIL otherwise. With `with_src_sizes` also returns an int [N, 2] array
+    of pre-resize (w, h) per image.
     """
     w, h = size
     n = len(paths)
+    out = np.empty((n, h, w, 3), np.float32)
+    dims = np.zeros((n, 2), np.int32)
     lib = _load()
     if lib is None or n == 0:
-        return np.stack([_pil_load(p, size) for p in paths]) if n else \
-            np.empty((0, h, w, 3), np.float32)
+        for i, p in enumerate(paths):
+            out[i], dims[i] = _pil_load(p, size)
+        return (out, dims) if with_src_sizes else out
     if num_threads <= 0:
         num_threads = os.cpu_count() or 1
-    out = np.empty((n, h, w, 3), np.float32)
     rcs = np.zeros(n, np.int32)
-    arr = (ctypes.c_char_p * n)(*[os.fspath(p).encode() for p in paths])
+    arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
     lib.mtio_load_resize_batch(
         arr, n, w, h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        num_threads, rcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+        num_threads, rcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
     for i in np.nonzero(rcs)[0]:
-        out[i] = _pil_load(paths[i], size)  # per-item fallback
-    return out
+        out[i], dims[i] = _pil_load(paths[i], size)  # per-item fallback
+    return (out, dims) if with_src_sizes else out
 
 
 def resize_rgb_u8(img: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
@@ -122,19 +137,25 @@ def resize_rgb_u8(img: np.ndarray, size: Tuple[int, int]) -> np.ndarray:
 
     For loaders that crop before resizing (e.g. the flowers lenslet grid).
     """
-    assert img.dtype == np.uint8 and img.ndim == 3 and img.shape[2] == 3, \
-        img.shape
-    w, h = size
-    lib = _load()
-    if lib is None:
+    if img.dtype != np.uint8 or img.ndim != 3 or img.shape[2] != 3:
+        raise ValueError(f"expected uint8 HWC RGB, got {img.dtype} "
+                         f"{img.shape}")
+
+    def pil_resize():
         from PIL import Image as PILImage
         pil = PILImage.fromarray(img).resize(size, PILImage.BICUBIC)
         return np.asarray(pil, dtype=np.float32) / 255.0
+
+    w, h = size
+    lib = _load()
+    if lib is None:
+        return pil_resize()
     img = np.ascontiguousarray(img)
     out = np.empty((h, w, 3), np.float32)
     rc = lib.mtio_resize_u8(
         img.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
         img.shape[1], img.shape[0], w, h,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-    assert rc == 0, rc
+    if rc != 0:  # native allocation/shape failure — same answer via PIL
+        return pil_resize()
     return out

@@ -105,6 +105,9 @@ bool decode_png(const char* path, std::vector<uint8_t>* out, int* w, int* h) {
   image.version = PNG_IMAGE_VERSION;
   if (!png_image_begin_read_from_file(&image, path)) return false;
   image.format = PNG_FORMAT_RGBA;
+  // PIL ignores gAMA/iCCP at decode; suppress libpng's to-sRGB conversion
+  // so files with gamma chunks decode to the same raw samples PIL returns
+  image.flags |= PNG_IMAGE_FLAG_COLORSPACE_NOT_sRGB;
   *w = image.width;
   *h = image.height;
   if (size_t(*w) * *h > kMaxPixels) {
@@ -263,12 +266,18 @@ extern "C" {
 // No C++ exception may cross the C ABI (ctypes caller -> std::terminate),
 // so every failure — including allocation — becomes a nonzero rc and the
 // Python wrapper's PIL fallback takes over.
-int mtio_load_resize(const char* path, int out_w, int out_h, float* out) {
+// src_w/src_h (nullable) receive the pre-resize image dimensions, so
+// callers that need them (intrinsics rescaling in the llff/dtu loaders)
+// don't pay a second file open for a header probe.
+int mtio_load_resize(const char* path, int out_w, int out_h, float* out,
+                     int* src_w, int* src_h) {
   try {
     std::vector<uint8_t> rgb;
     int w = 0, h = 0;
     if (!decode_file(path, &rgb, &w, &h)) return 1;
     if (out_w <= 0 || out_h <= 0) return 1;
+    if (src_w) *src_w = w;
+    if (src_h) *src_h = h;
     resize_u8_to_f32(rgb.data(), w, h, out_w, out_h, out);
     return 0;
   } catch (...) {
@@ -277,14 +286,19 @@ int mtio_load_resize(const char* path, int out_w, int out_h, float* out) {
 }
 
 // Batch variant across `nthreads` C++ threads. out: [n, out_h, out_w, 3]
-// f32; rcs[i]: 0 success / 1 decode error for paths[i].
+// f32; rcs[i]: 0 success / 1 decode error; src_dims (nullable): [n, 2]
+// (w, h) pre-resize sizes.
 void mtio_load_resize_batch(const char** paths, int n, int out_w, int out_h,
-                            float* out, int nthreads, int* rcs) {
+                            float* out, int nthreads, int* rcs,
+                            int* src_dims) {
   std::atomic<int> next(0);
   size_t stride = size_t(out_h) * out_w * 3;
   auto worker = [&]() {
     for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1))
-      rcs[i] = mtio_load_resize(paths[i], out_w, out_h, out + stride * i);
+      rcs[i] = mtio_load_resize(
+          paths[i], out_w, out_h, out + stride * i,
+          src_dims ? src_dims + 2 * i : nullptr,
+          src_dims ? src_dims + 2 * i + 1 : nullptr);
   };
   int k = std::max(1, std::min(nthreads, n));
   std::vector<std::thread> pool;

@@ -72,6 +72,14 @@ def test_grayscale_and_palette_png(built, tmp_path):
     ref = _pil_ref(pg, (30, 20))
     assert np.abs(ours - ref).max() <= ATOL
 
+    rgb = (rng.uniform(size=(40, 50, 3)) * 255).astype(np.uint8)
+    pp = str(tmp_path / "palette.png")
+    PILImage.fromarray(rgb).convert(
+        "P", palette=PILImage.ADAPTIVE).save(pp)
+    ours = native.load_image_rgb(pp, (30, 20))
+    ref = _pil_ref(pp, (30, 20))
+    assert np.abs(ours - ref).max() <= ATOL
+
     gj = str(tmp_path / "gray.jpg")
     PILImage.fromarray(gray, mode="L").save(gj)
     ours = native.load_image_rgb(gj, (30, 20))
@@ -112,6 +120,17 @@ def test_rgba_png_drops_alpha_like_pil(built, tmp_path):
     PILImage.fromarray(rgba, mode="RGBA").save(pa)
     ours = native.load_image_rgb(pa, (30, 20))
     ref = _pil_ref(pa, (30, 20))
+    assert np.abs(ours - ref).max() <= ATOL
+
+
+def test_gamma_png_not_converted(built, tmp_path):
+    """PIL ignores gAMA chunks at decode; libpng must not sRGB-convert."""
+    rng = np.random.RandomState(4)
+    img = (rng.uniform(size=(40, 50, 3)) * 255).astype(np.uint8)
+    pg = str(tmp_path / "gamma.png")
+    PILImage.fromarray(img).save(pg, gamma=1.0 / 2.4)
+    ours = native.load_image_rgb(pg, (30, 20))
+    ref = _pil_ref(pg, (30, 20))
     assert np.abs(ours - ref).max() <= ATOL
 
 

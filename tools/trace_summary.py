@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace: per-lane top ops by total duration.
+
+Input: a profile dir as written by jax.profiler.start_trace (bench.py's
+MINE_TPU_BENCH_PROFILE / eval_cli.py --profile_dir). JAX writes a Chrome
+trace (<host>.trace.json.gz) next to the xplane.pb; this reads the former —
+no tensorboard/protobuf toolchain needed (the image's
+tensorboard_plugin_profile is incompatible with its tensorflow build).
+
+Lanes are (process, thread) pairs from the trace metadata: on TPU runs the
+device process has "XLA Ops" / "XLA Modules" / "Steps" lanes — "XLA Ops"
+totals are the time attribution the round-1 verdict asks for (encoder vs
+decoder vs warp vs composite vs losses; mine_tpu names its hot scopes via
+jax.named_scope, see train/step.py).
+
+Usage: python tools/trace_summary.py <profile_dir> [--top N] [--json]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_traces(root):
+    """Newest run dir's *.trace.json.gz files under a profile root."""
+    pats = [os.path.join(root, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(root, "*.trace.json.gz")]
+    hits = []
+    for p in pats:
+        hits.extend(glob.glob(p))
+    if not hits:
+        return []
+    newest_dir = max((os.path.dirname(h) for h in hits),
+                     key=lambda d: os.path.getmtime(d))
+    return sorted(glob.glob(os.path.join(newest_dir, "*.trace.json.gz")))
+
+
+def summarize(trace_path, top=15):
+    data = json.load(gzip.open(trace_path, "rt"))
+    events = data.get("traceEvents", [])
+
+    proc_names = {}
+    thread_names = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"]["name"]
+        elif e.get("name") == "thread_name":
+            thread_names[(e["pid"], e["tid"])] = e["args"]["name"]
+
+    # Host lanes nest their complete events (outer TraceMe spans enclose
+    # inner ones); attribute SELF time — an event's duration minus its
+    # children's — so lane totals don't double-count and sum to the lane's
+    # busy time. Device "XLA Ops" lanes are flat, where self == duration.
+    per_lane = collections.defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        per_lane[(e["pid"], e.get("tid"))].append(
+            (e["ts"], e["dur"], e.get("name", "?")))
+
+    # lane -> name -> [self_us, count]
+    lanes = {}
+    lane_span = {}
+    for key, evs in per_lane.items():
+        evs.sort(key=lambda t: (t[0], -t[1]))
+        # sweep with an open-event stack; each event gets a child-time box
+        # that its direct children fill in (children always appear before
+        # any event that starts after their parent closes)
+        stack = []    # (end_ts, child_box) of currently-open events
+        closed = []   # (name, dur, child_box)
+        for ts, dur, name in evs:
+            while stack and stack[-1][0] <= ts + 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][1][0] += dur
+            child = [0.0]
+            stack.append((ts + dur, child))
+            closed.append((name, dur, child))
+        agg = collections.defaultdict(lambda: [0.0, 0])
+        for name, dur, child in closed:
+            a = agg[name]
+            a[0] += max(dur - child[0], 0.0)
+            a[1] += 1
+        lanes[key] = agg
+        lane_span[key] = [min(t for t, _, _ in evs),
+                          max(t + d for t, d, _ in evs)]
+
+    out = []
+    for key, names in sorted(lanes.items()):
+        pid, tid = key
+        lane = {
+            "process": proc_names.get(pid, str(pid)),
+            "thread": thread_names.get(key, str(tid)),
+            "span_ms": round((lane_span[key][1] - lane_span[key][0]) / 1e3, 3),
+            # self-times sum to lane busy time (no double counting)
+            "total_ms": round(sum(v[0] for v in names.values()) / 1e3, 3),
+            "top": [
+                {"name": n, "self_ms": round(v[0] / 1e3, 3), "count": v[1]}
+                for n, v in sorted(names.items(),
+                                   key=lambda kv: -kv[1][0])[:top]
+            ],
+        }
+        out.append(lane)
+    # device lanes first, biggest total first
+    out.sort(key=lambda l: (not l["process"].lower().startswith("/device"),
+                            -l["total_ms"]))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("profile_dir")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args()
+
+    traces = find_traces(args.profile_dir)
+    if not traces:
+        print("no *.trace.json.gz under %s" % args.profile_dir,
+              file=sys.stderr)
+        sys.exit(1)
+
+    report = {os.path.basename(t): summarize(t, args.top) for t in traces}
+    if args.json:
+        print(json.dumps(report))
+        return
+    for fname, lanes in report.items():
+        print("== %s" % fname)
+        for lane in lanes:
+            print("-- %s | %s | span %.1f ms, busy %.1f ms"
+                  % (lane["process"], lane["thread"], lane["span_ms"],
+                     lane["total_ms"]))
+            for row in lane["top"]:
+                print("   %9.3f ms  x%-5d %s"
+                      % (row["self_ms"], row["count"], row["name"][:100]))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,9 @@ VARIANTS = {
     "pallas_bf16_b4": (4, {"training.warp_backend": "pallas_diff",
                            "training.composite_backend": "pallas_diff",
                            "training.warp_dtype": "bfloat16"}),
+    "xlabanded_b4": (4, {"training.warp_backend": "xla_banded"}),
+    "xlabanded_bf16_b8": (8, {"training.warp_backend": "xla_banded",
+                              "training.warp_dtype": "bfloat16"}),
 }
 
 

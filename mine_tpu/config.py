@@ -106,9 +106,10 @@ class MPIConfig:
     # custom-VJP backward; plane_scan = distributed plane-axis transparency
     # scan for plane-parallel meshes, ops/plane_scan.py)
     composite_backend: str = "xla"
-    # "xla" | "pallas_diff": backend for the training-path homography warp
-    # ("pallas_diff" = banded MXU kernel fwd+bwd with a runtime gather
-    # fallback for rotation-heavy poses; kernels/warp_vjp.py)
+    # "xla" | "xla_banded" | "pallas_diff": training-path homography warp
+    # ("xla_banded" = banded one-hot-matmul in pure XLA, ops/warp_banded.py;
+    # "pallas_diff" = banded MXU kernel fwd+bwd, kernels/warp_vjp.py; both
+    # carry a runtime gather fallback for rotation-heavy poses)
     warp_backend: str = "xla"
     warp_band: int = 32
     # matmul operand dtype inside the banded warp kernels ("float32" |
@@ -148,9 +149,9 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
             f"training.composite_backend must be xla|pallas_diff|plane_scan, "
             f"got {backend!r}")
     warp_backend = g("training.warp_backend", "xla")
-    if warp_backend not in ("xla", "pallas_diff"):
+    if warp_backend not in ("xla", "xla_banded", "pallas_diff"):
         raise ValueError(
-            f"training.warp_backend must be xla|pallas_diff, "
+            f"training.warp_backend must be xla|xla_banded|pallas_diff, "
             f"got {warp_backend!r}")
     warp_dtype = g("training.warp_dtype", "float32")
     if warp_dtype not in ("float32", "bfloat16"):

@@ -134,6 +134,19 @@ def pallas_bilinear_sample(src: jnp.ndarray,
     )(y0, xc, yc, src.astype(jnp.float32))
 
 
+def fwd_domain_ok(coords_y: jnp.ndarray, H_s: int, band: int,
+                  rows_per_block: int = 8) -> jnp.ndarray:
+    """Scalar bool (jit-safe): every row-block's source span fits the band.
+
+    THE definition of the banded forward's correctness domain (span + 2
+    rows of bilinear support must fit the band, clamped to the image) —
+    shared by the Pallas VJP guard (kernels/warp_vjp.py) and the pure-XLA
+    banded warp (ops/warp_banded.py) so the two backends can never diverge
+    on which poses count as in-band. coords_y must be border-clipped.
+    """
+    return band_span(coords_y, H_s, rows_per_block) + 2.0 <= min(band, H_s)
+
+
 def band_span(coords_y: jnp.ndarray, H_s: int,
               rows_per_block: int = 8) -> jnp.ndarray:
     """Max per-row-block source-row span (rows needed = span + 2).

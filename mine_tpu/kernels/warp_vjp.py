@@ -45,7 +45,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mine_tpu.kernels.warp import band_span, pallas_bilinear_sample
+from mine_tpu.kernels.warp import fwd_domain_ok, pallas_bilinear_sample
 
 
 def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
@@ -203,7 +203,7 @@ def diff_domain_ok(src_shape, coords_y, band: int, oband: int,
     target-row span needs <= oband rows."""
     _, _, H_s, W_s = src_shape
     yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
-    fwd_ok = band_span(yc, H_s, rows_per_block) + 2.0 <= min(band, H_s)
+    fwd_ok = fwd_domain_ok(yc, H_s, band, rows_per_block)
 
     first, last, any_touch = _touch_bounds(yc, H_s, rows_per_block)
     span = jnp.where(any_touch, last - first + 1, 0)

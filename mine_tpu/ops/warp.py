@@ -96,10 +96,13 @@ def homography_warp(src_BCHW: jnp.ndarray,
       G_tgt_src: [B', 4, 4]
       K_src_inv, K_tgt: [B', 3, 3]
       meshgrid_tgt: [3, Ht, Wt] homogeneous target pixel grid
-      impl: "xla" (gather; autodiffed), "pallas" (banded MXU gather kernel,
-        forward-only; caller must validate the band via
+      impl: "xla" (gather; autodiffed), "xla_banded" (banded one-hot-matmul
+        in pure XLA with a runtime gather fallback — autodiffed, trainable,
+        GSPMD-partitionable; ops/warp_banded.py), "pallas" (banded MXU
+        gather kernel, forward-only; caller must validate the band via
         kernels.warp.band_span), or "pallas_diff" (banded fwd+bwd kernels
-        with a built-in runtime gather fallback — the training backend)
+        with a built-in runtime gather fallback — the Pallas training
+        backend)
       mesh: ("data","plane") jax Mesh. With impl="pallas_diff" on a
         multi-device mesh the kernel runs under shard_map with the flat
         B' axis split over data*plane (matching the decoder's B*S layout,
@@ -125,6 +128,14 @@ def homography_warp(src_BCHW: jnp.ndarray,
     if impl == "pallas":
         from mine_tpu.kernels.warp import pallas_bilinear_sample
         tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band)
+    elif impl == "xla_banded":
+        # banded one-hot-matmul warp in pure XLA (ops/warp_banded.py):
+        # differentiable by autodiff and GSPMD-partitionable directly, so
+        # no shard_map wrapper or mesh-divisibility guard is needed
+        from mine_tpu.ops.warp_banded import banded_bilinear_sample_guarded
+        tgt = banded_bilinear_sample_guarded(
+            src_BCHW, jax.lax.stop_gradient(x), jax.lax.stop_gradient(y),
+            band=band, mxu_dtype=mxu_dtype)
     elif impl == "pallas_diff":
         # training path: banded Pallas fwd+bwd with runtime gather fallback
         # outside the band domain (kernels/warp_vjp.py). Coords are

@@ -86,6 +86,7 @@ VARIANTS = {
     "xlabanded_b4": (4, {"training.warp_backend": "xla_banded"}),
     "xlabanded_bf16_b8": (8, {"training.warp_backend": "xla_banded",
                               "training.warp_dtype": "bfloat16"}),
+    "xla_bf16warp_b8": (8, {"training.warp_dtype": "bfloat16"}),
 }
 
 

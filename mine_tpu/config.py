@@ -112,8 +112,10 @@ class MPIConfig:
     # carry a runtime gather fallback for rotation-heavy poses)
     warp_backend: str = "xla"
     warp_band: int = 32
-    # matmul operand dtype inside the banded warp kernels ("float32" |
-    # "bfloat16"; bf16 doubles MXU rate at ~2^-8 weight rounding)
+    # warp value dtype ("float32" | "bfloat16"): matmul operands in the
+    # banded backends (bf16 doubles MXU rate) AND gather storage on the
+    # default xla backend (bf16 halves the volume's HBM traffic); either
+    # way ~2^-8 relative value rounding, accumulation/lerp stays f32
     warp_dtype: str = "float32"
     use_disparity_loss: bool = True   # disp_lambda=0 for flowers/kitti_raw/dtu
     use_scale_factor: bool = True     # scale_factor=1 for flowers/kitti_raw/dtu

@@ -101,10 +101,7 @@ def pallas_bilinear_sample(src: jnp.ndarray,
     xc = jnp.clip(coords_x, 0.0, W_s - 1.0).astype(jnp.float32)
     yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
 
-    # band start per (plane, row-block): floor of the block's min source row
-    y_blocks = yc.reshape(Bp, NB, RT * W_t)
-    y0 = jnp.floor(jnp.min(y_blocks, axis=2)).astype(jnp.int32)
-    y0 = jnp.clip(y0, 0, max(H_s - band, 0))  # [B', NB]
+    y0 = band_start(yc, H_s, band, RT)  # [B', NB]
 
     grid = (Bp, NB)
     kernel = functools.partial(_warp_kernel, C, band, RT, H_s, W_s,
@@ -132,6 +129,21 @@ def pallas_bilinear_sample(src: jnp.ndarray,
         ],
         interpret=interpret,
     )(y0, xc, yc, src.astype(jnp.float32))
+
+
+def band_start(coords_y_clipped: jnp.ndarray, H_s: int, band: int,
+               rows_per_block: int = 8) -> jnp.ndarray:
+    """Band start row per (plane, row-block): floor of the block's min
+    source row, clipped so the band stays inside the image. [B', NB] i32.
+
+    THE band placement rule — shared by the Pallas forward kernel and the
+    pure-XLA banded warp so the two backends sample identical bands.
+    """
+    Bp, H_t, W_t = coords_y_clipped.shape
+    NB = H_t // rows_per_block
+    y_blocks = coords_y_clipped.reshape(Bp, NB, rows_per_block * W_t)
+    y0 = jnp.floor(jnp.min(y_blocks, axis=2)).astype(jnp.int32)
+    return jnp.clip(y0, 0, max(H_s - band, 0))
 
 
 def fwd_domain_ok(coords_y: jnp.ndarray, H_s: int, band: int,

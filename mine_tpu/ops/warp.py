@@ -27,7 +27,8 @@ from mine_tpu import geometry
 
 def bilinear_sample(src: jnp.ndarray,
                     coords_x: jnp.ndarray,
-                    coords_y: jnp.ndarray) -> jnp.ndarray:
+                    coords_y: jnp.ndarray,
+                    gather_dtype=None) -> jnp.ndarray:
     """Bilinear sample with border padding at continuous pixel coords.
 
     Equivalent to torch grid_sample(border, align_corners=False) after the
@@ -36,9 +37,15 @@ def bilinear_sample(src: jnp.ndarray,
     Args:
       src: [B, C, H, W]
       coords_x, coords_y: [B, Ho, Wo] sample locations in src pixel coords
-    Returns: [B, C, Ho, Wo]
+      gather_dtype: optional storage dtype for the gathered values
+        (jnp.bfloat16 halves the HBM traffic of the hot B*S x 7 x H x W
+        volume in both directions of autodiff at ~2^-8 relative value
+        rounding; the lerp itself runs in float32)
+    Returns: [B, C, Ho, Wo] float32
     """
     B, C, H, W = src.shape
+    if gather_dtype is not None:
+        src = src.astype(gather_dtype)
     # Border padding == clamp the sampling location into the pixel-center box.
     x = jnp.clip(coords_x, 0.0, W - 1.0)
     y = jnp.clip(coords_y, 0.0, H - 1.0)
@@ -65,6 +72,9 @@ def bilinear_sample(src: jnp.ndarray,
 
     tx = tx[:, None, :, :]
     ty = ty[:, None, :, :]
+    if gather_dtype is not None:  # lerp in f32 regardless of storage dtype
+        v00, v01, v10, v11 = (v.astype(jnp.float32)
+                              for v in (v00, v01, v10, v11))
     top = v00 * (1.0 - tx) + v01 * tx
     bot = v10 * (1.0 - tx) + v11 * tx
     return top * (1.0 - ty) + bot * ty
@@ -173,5 +183,9 @@ def homography_warp(src_BCHW: jnp.ndarray,
                 fn = bilinear_sample
         tgt = fn(src_BCHW, xs, ys)
     else:
-        tgt = bilinear_sample(src_BCHW, x, y)
+        # training.warp_dtype reaches the gather too: bf16 storage halves
+        # the volume's HBM traffic, lerp stays f32
+        tgt = bilinear_sample(
+            src_BCHW, x, y,
+            gather_dtype=None if mxu_dtype == jnp.float32 else mxu_dtype)
     return tgt, valid

@@ -34,7 +34,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from mine_tpu.kernels.warp import fwd_domain_ok
+from mine_tpu.kernels.warp import band_start, fwd_domain_ok
 
 
 @functools.partial(jax.jit, static_argnames=("band", "rows_per_block",
@@ -65,10 +65,7 @@ def banded_bilinear_sample(src: jnp.ndarray,
     xc = jnp.clip(coords_x, 0.0, W_s - 1.0).astype(jnp.float32)
     yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
 
-    # band start per (plane, row-block), as in kernels/warp.py
-    y_blocks = yc.reshape(Bp, NB, RT * W_t)
-    y0 = jnp.floor(jnp.min(y_blocks, axis=2)).astype(jnp.int32)
-    y0 = jnp.clip(y0, 0, max(H_s - band, 0))  # [B', NB]
+    y0 = band_start(yc, H_s, band, RT)  # [B', NB] — shared placement rule
 
     xs = jax.lax.broadcasted_iota(jnp.float32, (W_s, W_t), 0)   # src x pos
     ys = jax.lax.broadcasted_iota(jnp.float32, (band, W_t), 0)  # band y pos
@@ -115,10 +112,16 @@ def banded_bilinear_sample_guarded(src, coords_x, coords_y,
     """
     from mine_tpu.ops.warp import bilinear_sample
 
+    # the gather fallback honors the same value dtype (bf16 storage keeps
+    # the HBM-traffic benefit when the banded path bails); both paths
+    # return f32, so the cond branches agree
+    gather_dtype = None if mxu_dtype == jnp.float32 else mxu_dtype
+
     src = src.astype(jnp.float32)
     H_t = coords_x.shape[1]
     if H_t % rows_per_block != 0:
-        return bilinear_sample(src, coords_x, coords_y)
+        return bilinear_sample(src, coords_x, coords_y,
+                               gather_dtype=gather_dtype)
 
     H_s = src.shape[2]
     yc = jnp.clip(coords_y, 0.0, H_s - 1.0)
@@ -128,5 +131,5 @@ def banded_bilinear_sample_guarded(src, coords_x, coords_y,
         lambda s, x, y: banded_bilinear_sample(
             s, x, y, band=band, rows_per_block=rows_per_block,
             mxu_dtype=mxu_dtype),
-        lambda s, x, y: bilinear_sample(s, x, y),
+        lambda s, x, y: bilinear_sample(s, x, y, gather_dtype=gather_dtype),
         src, coords_x, coords_y)

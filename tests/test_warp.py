@@ -114,3 +114,21 @@ def test_warp_gradients_flow_through_values():
 
     # pose gradient via the grid is intentionally blocked
     assert float(jax.grad(loss_t)(0.1)) == 0.0
+
+
+def test_bilinear_sample_bf16_gather_close():
+    """gather_dtype=bfloat16 (training.warp_dtype on the gather path) stays
+    within bf16 value rounding of the f32 gather."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mine_tpu.ops.warp import bilinear_sample
+    B, C, H, W = 2, 7, 24, 32
+    src = jax.random.uniform(jax.random.PRNGKey(0), (B, C, H, W))
+    cx = jax.random.uniform(jax.random.PRNGKey(1), (B, H, W)) * (W - 1)
+    cy = jax.random.uniform(jax.random.PRNGKey(2), (B, H, W)) * (H - 1)
+    ref = bilinear_sample(src, cx, cy)
+    out = bilinear_sample(src, cx, cy, gather_dtype=jnp.bfloat16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)

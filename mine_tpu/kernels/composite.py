@@ -40,15 +40,24 @@ def _pick_tile_h(H: int, W: int, S: int,
     """Largest H-tile (multiple of 8 or == H) keeping the block under budget.
 
     rows_per_plane = plane-sized f32 rows resident per spatial row (inputs +
-    outputs + scratch); the backward kernel passes a larger value."""
+    outputs + scratch); the backward kernel passes a larger value.
+
+    When H has NO divisor that is a multiple of 8 (e.g. H=756 full-res
+    eval), the only Mosaic-legal tile is H itself and the budget cannot be
+    honored — the resulting full-height block may exceed VMEM and fail to
+    compile. Such shapes should use the XLA composite path instead."""
     per_row = S * rows_per_plane * W * 4
-    th = max(1, budget // max(per_row, 1))
-    th = min(th, H)
-    if th >= 8:
-        th = (th // 8) * 8
-    while H % th != 0:
-        th -= 1
-    return max(th, 1)
+    fit = min(max(1, budget // max(per_row, 1)), H)
+    # Mosaic-legal tiles: divisors of H that are multiples of 8 (the f32
+    # sublane tile), or H itself. Largest legal tile within budget; if the
+    # budget admits none, the smallest legal tile — over budget beats an
+    # illegal block (~12 MB double-buffered at the worst LLFF bwd shape,
+    # within the ~16 MB/core VMEM; validated on-device).
+    legal = [d for d in range(8, H + 1, 8) if H % d == 0]
+    in_budget = [d for d in legal if d <= fit]
+    if in_budget:
+        return max(in_budget)
+    return min(legal) if legal else H
 
 
 def _tgt_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,

@@ -44,18 +44,23 @@ def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
                  mxu_dtype, y0_ref, xc_ref, yc_ref, src_ref, out_ref,
                  band_buf, sem):
     W_t = xc_ref.shape[2]
-    y0 = y0_ref[0, 0]
+    # y0 comes in as the FULL [B', NB] table in SMEM (a (1,1) block would
+    # violate the Mosaic last-two-dims tiling rule); index it by grid step
+    y0 = y0_ref[pl.program_id(0), pl.program_id(1)]
 
+    # src arrives as the FULL array in HBM (ANY-space blocks must equal the
+    # array shape); the batch index is applied here, the band via dynamic DMA
     dma = pltpu.make_async_copy(
-        src_ref.at[0, :, pl.ds(y0, BAND), :], band_buf, sem)
+        src_ref.at[pl.program_id(0), :, pl.ds(y0, BAND), :], band_buf, sem)
     dma.start()
     dma.wait()
 
     # mxu_dtype=bfloat16 halves the matmul operand width (2x MXU rate);
     # tent weights pick up ~2^-8 relative rounding, accumulation stays f32
     band = band_buf[:].reshape(C * BAND, W_s).astype(mxu_dtype)
-    xs = jax.lax.broadcasted_iota(jnp.float32, (W_s, W_t), 0)
-    ys = jax.lax.broadcasted_iota(jnp.float32, (BAND, W_t), 0)
+    # Mosaic iota must be integer-typed; cast to f32 for the tent weights
+    xs = jax.lax.broadcasted_iota(jnp.int32, (W_s, W_t), 0).astype(jnp.float32)
+    ys = jax.lax.broadcasted_iota(jnp.int32, (BAND, W_t), 0).astype(jnp.float32)
 
     for r in range(RT):
         sx = xc_ref[0, r:r + 1, :]                      # [1, W_t]
@@ -111,13 +116,13 @@ def pallas_bilinear_sample(src: jnp.ndarray,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, r: (b, r),
+            pl.BlockSpec((Bp, NB), lambda b, r: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, RT, W_t), lambda b, r: (b, r, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, RT, W_t), lambda b, r: (b, r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, C, H_s, W_s), lambda b, r: (b, 0, 0, 0),
+            pl.BlockSpec((Bp, C, H_s, W_s), lambda b, r: (0, 0, 0, 0),
                          memory_space=pl.ANY),  # stays in HBM; banded DMA
         ],
         out_specs=pl.BlockSpec((1, C, RT, W_t), lambda b, r: (b, 0, r, 0),

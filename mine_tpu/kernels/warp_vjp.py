@@ -54,23 +54,30 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
     """Grid step (b, source-row-block): splat OBAND gradient rows into RS
     source rows via transposed tent-weight contractions."""
     W_s = out_ref.shape[3]
-    o0 = o0_ref[0, 0]
+    # full [B', NBs] table in SMEM (a (1,1) block would violate the Mosaic
+    # last-two-dims tiling rule); index it by grid step
+    o0 = o0_ref[pl.program_id(0), pl.program_id(1)]
     sb = pl.program_id(1)
     h0 = (sb * RS).astype(jnp.float32)
 
+    # g/xc/yc arrive as FULL arrays in HBM (ANY-space blocks must equal the
+    # array shape); batch indexing happens here, the band via dynamic DMA
+    b = pl.program_id(0)
     dma_g = pltpu.make_async_copy(
-        g_ref.at[0, :, pl.ds(o0, OBAND), :], g_buf, sem_g)
+        g_ref.at[b, :, pl.ds(o0, OBAND), :], g_buf, sem_g)
     dma_x = pltpu.make_async_copy(
-        xc_ref.at[0, pl.ds(o0, OBAND), :], xc_buf, sem_x)
+        xc_ref.at[b, pl.ds(o0, OBAND), :], xc_buf, sem_x)
     dma_y = pltpu.make_async_copy(
-        yc_ref.at[0, pl.ds(o0, OBAND), :], yc_buf, sem_y)
+        yc_ref.at[b, pl.ds(o0, OBAND), :], yc_buf, sem_y)
     dma_g.start(); dma_x.start(); dma_y.start()
     dma_g.wait(); dma_x.wait(); dma_y.wait()
 
     # source-x positions along the lane axis, per gradient row's sample x
-    ws = jax.lax.broadcasted_iota(jnp.float32, (W_t, W_s), 1)
+    # (Mosaic iota must be integer-typed; cast to f32 for the tent weights)
+    ws = jax.lax.broadcasted_iota(jnp.int32, (W_t, W_s), 1).astype(jnp.float32)
     # source rows of this block, relative iota + h0
-    hs = jax.lax.broadcasted_iota(jnp.float32, (RS, W_t), 0) + h0
+    hs = jax.lax.broadcasted_iota(jnp.int32, (RS, W_t), 0).astype(
+        jnp.float32) + h0
 
     accum = jnp.zeros((C * RS, W_s), jnp.float32)
     for ob in range(OBAND):
@@ -133,13 +140,13 @@ def _warp_bwd(g, coords_x, coords_y, src_shape,
         kernel,
         grid=(Bp, NBs),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, s: (b, s),
+            pl.BlockSpec((Bp, NBs), lambda b, s: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, C, H_t, W_t), lambda b, s: (b, 0, 0, 0),
+            pl.BlockSpec((Bp, C, H_t, W_t), lambda b, s: (0, 0, 0, 0),
                          memory_space=pl.ANY),   # gradient stays in HBM
-            pl.BlockSpec((1, H_t, W_t), lambda b, s: (b, 0, 0),
+            pl.BlockSpec((Bp, H_t, W_t), lambda b, s: (0, 0, 0),
                          memory_space=pl.ANY),
-            pl.BlockSpec((1, H_t, W_t), lambda b, s: (b, 0, 0),
+            pl.BlockSpec((Bp, H_t, W_t), lambda b, s: (0, 0, 0),
                          memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, C, RS, W_s), lambda b, s: (b, 0, s, 0),
@@ -226,10 +233,16 @@ def bilinear_sample_diff_guarded(src, coords_x, coords_y,
     (the kernel's accumulation dtype) so the two cond branches agree."""
     from mine_tpu.ops.warp import bilinear_sample
 
+    # the gather fallback honors the same reduced-precision knob as the
+    # kernel (mxu_dtype) via the f32-accumulating bf16 gather path, so
+    # fallback steps keep the HBM-traffic benefit (parity with
+    # ops/warp_banded.py's guard); f32 is a no-op knob
+    gather_dtype = mxu_dtype
     src = src.astype(jnp.float32)
     H_t = coords_x.shape[1]
     if H_t % rows_per_block != 0 or src.shape[2] % rows_per_block != 0:
-        return bilinear_sample(src, coords_x, coords_y)
+        return bilinear_sample(src, coords_x, coords_y,
+                               gather_dtype=gather_dtype)
 
     # The domain check recomputes coord min/max that the VJP's o0 derivation
     # also needs; both live in one XLA module per train step (CSE'd or not,
@@ -239,5 +252,5 @@ def bilinear_sample_diff_guarded(src, coords_x, coords_y,
         ok,
         lambda s, x, y: bilinear_sample_diff(
             s, x, y, band, oband, rows_per_block, interpret, mxu_dtype),
-        lambda s, x, y: bilinear_sample(s, x, y),
+        lambda s, x, y: bilinear_sample(s, x, y, gather_dtype=gather_dtype),
         src, coords_x, coords_y)

@@ -17,6 +17,7 @@ coordinates with border clamping. We implement that directly, skipping the
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -37,15 +38,31 @@ def bilinear_sample(src: jnp.ndarray,
     Args:
       src: [B, C, H, W]
       coords_x, coords_y: [B, Ho, Wo] sample locations in src pixel coords
-      gather_dtype: optional storage dtype for the gathered values
-        (jnp.bfloat16 halves the HBM traffic of the hot B*S x 7 x H x W
-        volume in both directions of autodiff at ~2^-8 relative value
-        rounding; the lerp itself runs in float32)
+      gather_dtype: optional storage dtype for the gathered FORWARD values
+        (jnp.bfloat16 halves the forward HBM read of the hot
+        B*S x 7 x H x W volume at ~2^-8 relative value rounding; the lerp
+        runs in float32 and the BACKWARD scatter-add accumulates in float32
+        via a custom VJP — a bf16 scatter would drop contributions below
+        ~2^-8 of the running sum wherever many target pixels hit the same
+        source texel. The bf16 path returns zero coordinate cotangents,
+        matching kernels/warp_vjp.py; every training caller stop-gradients
+        coords anyway.)
     Returns: [B, C, Ho, Wo] float32
     """
+    # float32 (or None) is the identity storage dtype -> plain autodiff path;
+    # any reduced dtype ALWAYS routes through the f32-accumulating custom VJP
+    # (even when src already arrives reduced — the plain path's backward
+    # would scatter-accumulate in the reduced dtype).
+    if gather_dtype is not None and jnp.dtype(gather_dtype) != jnp.float32:
+        return _bilinear_sample_cast(src.astype(jnp.float32), coords_x,
+                                     coords_y, jnp.dtype(gather_dtype).name)
+    return _lerp_gather(src, coords_x, coords_y)
+
+
+def _lerp_gather(src: jnp.ndarray, coords_x: jnp.ndarray,
+                 coords_y: jnp.ndarray) -> jnp.ndarray:
+    """Autodiffable core: gather in src's dtype, lerp in float32."""
     B, C, H, W = src.shape
-    if gather_dtype is not None:
-        src = src.astype(gather_dtype)
     # Border padding == clamp the sampling location into the pixel-center box.
     x = jnp.clip(coords_x, 0.0, W - 1.0)
     y = jnp.clip(coords_y, 0.0, H - 1.0)
@@ -72,12 +89,37 @@ def bilinear_sample(src: jnp.ndarray,
 
     tx = tx[:, None, :, :]
     ty = ty[:, None, :, :]
-    if gather_dtype is not None:  # lerp in f32 regardless of storage dtype
+    if src.dtype != jnp.float32:  # lerp in f32 regardless of storage dtype
         v00, v01, v10, v11 = (v.astype(jnp.float32)
                               for v in (v00, v01, v10, v11))
     top = v00 * (1.0 - tx) + v01 * tx
     bot = v10 * (1.0 - tx) + v11 * tx
     return top * (1.0 - ty) + bot * ty
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bilinear_sample_cast(src, coords_x, coords_y, gather_dtype: str):
+    """bf16-storage forward, f32-accumulating backward (see bilinear_sample)."""
+    return _lerp_gather(src.astype(gather_dtype), coords_x, coords_y)
+
+
+def _bsc_fwd(src, coords_x, coords_y, gather_dtype):
+    out = _bilinear_sample_cast(src, coords_x, coords_y, gather_dtype)
+    return out, (src.shape, coords_x, coords_y)
+
+
+def _bsc_bwd(gather_dtype, residuals, g):
+    src_shape, coords_x, coords_y = residuals
+    # The op is linear in src, so its transpose (the scatter-add) can run on
+    # the f32 core regardless of the forward's storage dtype; d/dsrc of the
+    # bf16 cast is identity (same as autodiff's astype VJP).
+    d_src, = jax.linear_transpose(
+        lambda s: _lerp_gather(s, coords_x, coords_y),
+        jax.ShapeDtypeStruct(src_shape, jnp.float32))(g.astype(jnp.float32))
+    return d_src, jnp.zeros_like(coords_x), jnp.zeros_like(coords_y)
+
+
+_bilinear_sample_cast.defvjp(_bsc_fwd, _bsc_bwd)
 
 
 def homography_warp(src_BCHW: jnp.ndarray,
@@ -151,8 +193,6 @@ def homography_warp(src_BCHW: jnp.ndarray,
         # outside the band domain (kernels/warp_vjp.py). Coords are
         # non-learnable (no-grad inverse above), so stop_gradient keeps the
         # two branches' autodiff structurally identical.
-        import functools
-
         from mine_tpu.kernels import on_tpu_backend
         from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
         fn = functools.partial(bilinear_sample_diff_guarded,
@@ -179,13 +219,13 @@ def homography_warp(src_BCHW: jnp.ndarray,
             else:
                 # a bare pallas_call inside a GSPMD-partitioned program has
                 # no partitioning spec — fall back to the autodiffed gather
-                # for non-divisible batches (e.g. remainder eval examples)
-                fn = bilinear_sample
+                # for non-divisible batches (e.g. remainder eval examples);
+                # keep the reduced-precision storage knob on this path too
+                fn = functools.partial(bilinear_sample,
+                                       gather_dtype=mxu_dtype)
         tgt = fn(src_BCHW, xs, ys)
     else:
         # training.warp_dtype reaches the gather too: bf16 storage halves
-        # the volume's HBM traffic, lerp stays f32
-        tgt = bilinear_sample(
-            src_BCHW, x, y,
-            gather_dtype=None if mxu_dtype == jnp.float32 else mxu_dtype)
+        # the volume's HBM traffic, lerp stays f32 (f32 is a no-op knob)
+        tgt = bilinear_sample(src_BCHW, x, y, gather_dtype=mxu_dtype)
     return tgt, valid

@@ -114,8 +114,8 @@ def banded_bilinear_sample_guarded(src, coords_x, coords_y,
 
     # the gather fallback honors the same value dtype (bf16 storage keeps
     # the HBM-traffic benefit when the banded path bails); both paths
-    # return f32, so the cond branches agree
-    gather_dtype = None if mxu_dtype == jnp.float32 else mxu_dtype
+    # return f32, so the cond branches agree (f32 is a no-op knob)
+    gather_dtype = mxu_dtype
 
     src = src.astype(jnp.float32)
     H_t = coords_x.shape[1]

@@ -132,3 +132,46 @@ def test_bilinear_sample_bf16_gather_close():
     out = bilinear_sample(src, cx, cy, gather_dtype=jnp.bfloat16)
     assert out.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
+
+
+def test_bilinear_sample_bf16_backward_accumulates_f32():
+    """The bf16-storage gather's backward scatter must accumulate in f32.
+
+    Adversarial case: EVERY target pixel samples the same source texel, so
+    d_src at that texel is a sum of Ho*Wo cotangents. A bf16 scatter-add
+    stalls once the running sum is ~2^8 times a contribution; the custom-VJP
+    f32 scatter must match the f32 path near-exactly (not at bf16 rounding).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mine_tpu.ops.warp import bilinear_sample
+    B, C, H, W = 1, 1, 8, 1024
+    src = jnp.ones((B, C, H, W), jnp.float32)
+    # all coords at exactly texel (2, 3): integer coords, no lerp spread
+    cx = jnp.full((B, H, W), 3.0)
+    cy = jnp.full((B, H, W), 2.0)
+
+    def loss(s, dt):
+        return jnp.sum(bilinear_sample(s, cx, cy, gather_dtype=dt))
+
+    g_ref = jax.grad(loss)(src, None)
+    g_bf = jax.grad(loss)(src, jnp.bfloat16)
+    assert g_bf.dtype == jnp.float32
+    # the hot texel accumulates H*W = 8192 ones; bf16 accumulation would
+    # plateau around 256
+    assert float(g_ref[0, 0, 2, 3]) == float(H * W)
+    np.testing.assert_allclose(np.asarray(g_bf), np.asarray(g_ref), rtol=1e-6)
+
+    # gradient must also match for fractional coords (lerp weights applied)
+    cx2 = jnp.full((B, H, W), 3.25)
+    cy2 = jnp.full((B, H, W), 2.5)
+
+    def loss2(s, dt):
+        return jnp.sum(bilinear_sample(s, cx2, cy2, gather_dtype=dt) ** 2)
+
+    g2_ref = jax.grad(loss2)(src, None)
+    g2_bf = jax.grad(loss2)(src, jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(g2_bf), np.asarray(g2_ref),
+                               rtol=2e-2)

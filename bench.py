@@ -63,7 +63,9 @@ HEIGHT, WIDTH = (64, 64) if SMOKE else (256, 384)
 PLANES = 4 if SMOKE else 32
 NUM_LAYERS = 18 if SMOKE else 50
 WARMUP_STEPS = 1 if SMOKE else 3
-MEASURE_STEPS = 2 if SMOKE else 20
+# 60 steps ~ a few seconds at realistic speeds; 20 produced a 0.35 s sample
+# whose 226 img/s reading implied >peak FLOP rate (see _measure's readback)
+MEASURE_STEPS = 2 if SMOKE else 60
 
 INIT_TIMEOUT = float(os.environ.get("MINE_TPU_BENCH_INIT_TIMEOUT",
                                     60 if SMOKE else 240))
@@ -133,10 +135,20 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
         t0 = time.perf_counter()
         for _ in range(n):
             state, metrics = trainer.train_step(state, batch)
-        jax.block_until_ready(metrics)
+        # A real device->host readback of a computed value, not just
+        # block_until_ready: the steps chain through `state`, so fetching
+        # the LAST step's loss can only complete after every step's
+        # compute. Auditing the axon tunnel — a 20-step sample once read
+        # 226 img/s, an implied >peak 256 TFLOP/s (4.53 TFLOP/step per
+        # jax.jit(...).lower(...).cost_analysis() vs the v5e's ~197
+        # TFLOP/s bf16), so the backend's ready signal is not trusted.
+        float(jax.device_get(jax.tree.leaves(metrics)[0]))
         return time.perf_counter() - t0
 
     dt = run(steps)
+    print("  %s: %d steps in %.3fs (%.1f ms/step)"
+          % (trainer.__class__.__name__, steps, dt, 1e3 * dt / steps),
+          file=sys.stderr)
     return batch_size * steps / dt, (run if keep_run else None)
 
 

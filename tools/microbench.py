@@ -170,7 +170,11 @@ def _child(case: str, outdir: str) -> None:
         t0 = time.perf_counter()
         for _ in range(ITERS):
             out = fn(*args)
-        jax.block_until_ready(out)
+        # real device->host readback, not just block_until_ready — the axon
+        # tunnel's ready signal is under audit (see bench.py _measure);
+        # iterations serialize on the device queue, so the last result's
+        # value completes after all of them
+        jax.device_get(jax.tree.leaves(out)[0])
         ms = (time.perf_counter() - t0) / ITERS * 1e3
         write({"ms_per_iter": round(ms, 3)})
         print("[%s] %.3f ms/iter" % (case, ms), file=sys.stderr)

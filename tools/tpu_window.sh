@@ -1,0 +1,70 @@
+#!/bin/sh
+# Use a TPU-availability window efficiently, highest-value first.
+#
+# The axon tunnel's chip comes and goes (wedge history: ROADMAP.md,
+# BASELINE.md). Windows observed so far last ~45 min, so when the
+# background probe flips to OK, run THIS instead of improvising — it
+# walks the round's measurement backlog in priority order, each stage
+# under its own timeout so a re-wedge costs one stage, not the window:
+#
+#   1. headline bench (xla_b4, compile-cached from the last window) +
+#      jax.profiler trace -> the round's BENCH number and time attribution
+#   2. on-device kernel equivalence suites (the Pallas warp/composite
+#      kernels' numerics + VMEM fit on real hardware)
+#   3. Pallas-vs-XLA bench variants (the backend decision data)
+#   4. the rest of the sweep (clean b2 numbers etc.)
+#
+# Stage logs land in /tmp/tpu_window/; bench JSON lines are appended to
+# /tmp/tpu_window/bench_results.jsonl. Keep the HOST IDLE while this
+# runs: on this 1-core container any concurrent compile/test job starves
+# the measurement children (observed: 226 img/s clean vs 0.6 img/s
+# contended — BASELINE.md round-2 notes).
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_window
+mkdir -p "$OUT"
+stamp() { date +%H:%M:%S; }
+
+log() { echo "[$(stamp)] $*" | tee -a "$OUT/window.log"; }
+
+run_stage() {
+    name="$1"; tmo="$2"; shift 2
+    log "stage $name: $*"
+    timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+    rc=$?
+    log "stage $name: rc=$rc (log: $OUT/$name.log)"
+    return $rc
+}
+
+log "window start"
+
+# 0. quick probe — don't burn stage timeouts on a wedged chip
+run_stage probe 120 python -c "import jax; print(jax.devices())" || {
+    log "chip wedged; aborting window"; exit 1; }
+
+# 1. headline + profile (compile-cached after the first window)
+MINE_TPU_BENCH_VARIANTS=xla_b4 MINE_TPU_BENCH_PROFILE="$OUT/prof" \
+    run_stage bench_headline 1500 python bench.py \
+    && cp "$OUT/bench_headline.log" "$OUT/bench_results.jsonl.tmp" \
+    && grep -h '^{' "$OUT/bench_headline.log" >> "$OUT/bench_results.jsonl"
+
+# 2. kernels on device (first compiled runs of the banded warp pair)
+MINE_TPU_TESTS_ON_TPU=1 run_stage kernel_tests 2400 \
+    python -m pytest tests/test_warp_kernel.py tests/test_warp_vjp.py \
+    tests/test_kernels.py tests/test_composite_vjp.py -x -q
+
+# 3. backend decision: Pallas + banded-XLA variants at the bench config
+MINE_TPU_BENCH_VARIANTS=pallas_b4,xlabanded_b4 \
+    run_stage bench_backends 3600 python bench.py \
+    && grep -h '^{' "$OUT/bench_backends.log" >> "$OUT/bench_results.jsonl"
+
+# 4. the rest of the sweep
+MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2 \
+    run_stage bench_rest 5400 python bench.py \
+    && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
+
+# 5. summarize the profile while the numbers are fresh
+run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true
+
+log "window done — see $OUT/bench_results.jsonl and $OUT/trace_summary.log"

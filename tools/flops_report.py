@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static per-component cost attribution at the benchmark config (no TPU).
+
+`jax.jit(fn).lower(args).cost_analysis()` on the HLO gives flops / bytes
+for each component of the train step — the chip-free half of the time
+attribution the round-1 verdict asked for (the on-chip halves are
+tools/microbench.py and the bench profile). Flops are fusion-independent,
+so these numbers hold for the TPU executable; 'bytes accessed' of the
+UNFUSED lowering is only an upper bound and is labeled as such.
+
+This is also the sanity denominator for throughput claims: images/sec
+readings whose implied FLOP rate exceeds the chip's peak are measurement
+artifacts (BENCH_NOTES_r02.md round-2 example: 226 img/s x 4.53
+TFLOP/step = 256 TFLOP/s > the v5e's ~197 TFLOP/s bf16 peak => bogus).
+
+Usage: python tools/flops_report.py [--json]
+Runs on CPU (forced); ~10 min of tracing on a 1-core host.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import bench
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.train.step import SynthesisTrainer
+    from tools import microbench
+
+    rows = {}
+
+    def add(name, fn, *args):
+        ca = jax.jit(fn).lower(*args).cost_analysis()
+        rows[name] = {
+            "tflops": round(ca.get("flops", float("nan")) / 1e12, 4),
+            "gbytes_unfused_upper_bound": round(
+                ca.get("bytes accessed", float("nan")) / 1e9, 2),
+        }
+        print("%-28s %8.4f TFLOP   %8.2f GB (unfused upper bound)"
+              % (name, rows[name]["tflops"],
+                 rows[name]["gbytes_unfused_upper_bound"]), file=sys.stderr)
+
+    # full train step at the benchmark's headline variant
+    config, B = bench._variant_config("xla_b4")
+    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+    state = trainer.init_state(batch_size=B)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_batch(B, bench.HEIGHT, bench.WIDTH,
+                        num_points=256).items()}
+    add("train_step_b4", trainer._train_step_impl, state, batch)
+
+    # isolated components at the microbench shapes (B=2, S=32, 256x384)
+    for case in ("encoder_fwd", "model_fwd", "warp_xla_fwd",
+                 "warp_xla_fwdbwd", "comp_xla_fwd", "comp_xla_fwdbwd"):
+        fn, args = microbench._case_fn(case)
+        add(case + "_b2", fn, *args)
+
+    step = rows["train_step_b4"]["tflops"]
+    out = {
+        "config": "LLFF 384x256 N=32 bf16 ResNet-50 (bench.py)",
+        "components": rows,
+        "peak_bound_images_per_sec": {
+            "v5e_bf16_peak_tflops": V5E_BF16_PEAK_TFLOPS,
+            "at_100pct_mxu": round(4 * V5E_BF16_PEAK_TFLOPS / step, 1),
+            "at_40pct_mxu": round(0.4 * 4 * V5E_BF16_PEAK_TFLOPS / step, 1),
+        },
+    }
+    print(json.dumps(out if "--json" in sys.argv else out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

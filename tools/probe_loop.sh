@@ -1,0 +1,19 @@
+#!/bin/sh
+# Background chip-watch: probe the tunneled TPU every 10 min; the moment a
+# probe succeeds, run the prioritized measurement backlog (tpu_window.sh).
+# Log: /tmp/tpu_probe2.log. Start with:
+#   nohup sh tools/probe_loop.sh >/dev/null 2>&1 &
+# Keep the host otherwise idle while a window is running (BASELINE.md).
+LOG=/tmp/tpu_probe2.log
+cd "$(dirname "$0")/.."
+while true; do
+    ts=$(date +%H:%M:%S)
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "$ts OK - launching window" >> "$LOG"
+        sh tools/tpu_window.sh >> "$LOG" 2>&1
+        echo "$(date +%H:%M:%S) window finished" >> "$LOG"
+    else
+        echo "$ts WEDGED" >> "$LOG"
+    fi
+    sleep 600
+done

@@ -44,25 +44,29 @@ run_stage probe 120 python -c "import jax; print(jax.devices())" || {
     log "chip wedged; aborting window"; exit 1; }
 
 # 1. headline + profile (compile-cached after the first window)
-MINE_TPU_BENCH_VARIANTS=xla_b4 MINE_TPU_BENCH_PROFILE="$OUT/prof" \
-    run_stage bench_headline 1500 python bench.py \
-    && cp "$OUT/bench_headline.log" "$OUT/bench_results.jsonl.tmp" \
+export MINE_TPU_BENCH_VARIANTS=xla_b4
+export MINE_TPU_BENCH_PROFILE="$OUT/prof"
+run_stage bench_headline 1500 python bench.py \
     && grep -h '^{' "$OUT/bench_headline.log" >> "$OUT/bench_results.jsonl"
+unset MINE_TPU_BENCH_PROFILE
 
 # 2. kernels on device (first compiled runs of the banded warp pair)
-MINE_TPU_TESTS_ON_TPU=1 run_stage kernel_tests 2400 \
+export MINE_TPU_TESTS_ON_TPU=1
+run_stage kernel_tests 2400 \
     python -m pytest tests/test_warp_kernel.py tests/test_warp_vjp.py \
     tests/test_kernels.py tests/test_composite_vjp.py -x -q
+unset MINE_TPU_TESTS_ON_TPU
 
 # 3. backend decision: Pallas + banded-XLA variants at the bench config
-MINE_TPU_BENCH_VARIANTS=pallas_b4,xlabanded_b4 \
-    run_stage bench_backends 3600 python bench.py \
+export MINE_TPU_BENCH_VARIANTS=pallas_b4,xlabanded_b4
+run_stage bench_backends 3600 python bench.py \
     && grep -h '^{' "$OUT/bench_backends.log" >> "$OUT/bench_results.jsonl"
 
 # 4. the rest of the sweep
-MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2 \
-    run_stage bench_rest 5400 python bench.py \
+export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2
+run_stage bench_rest 5400 python bench.py \
     && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
+unset MINE_TPU_BENCH_VARIANTS
 
 # 5. summarize the profile while the numbers are fresh
 run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true

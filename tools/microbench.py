@@ -40,10 +40,16 @@ CASES = [
     "warp_xla_fwdbwd", "warp_pallas_diff_fwdbwd",
     "comp_xla_fwd", "comp_pallas_fwd",
     "comp_xla_fwdbwd", "comp_pallas_diff_fwdbwd",
+    # inference hot loop: one F-pose chunk of novel-view rendering (the
+    # reference renders video frames one by one, image_to_video.py:219-255;
+    # ours batches the pose axis — infer/video.py). frames/sec =
+    # RENDER_POSES / (ms_per_iter / 1e3).
+    "render_poses_xla", "render_poses_pallas",
 ]
+RENDER_POSES = 2 if SMOKE else 8
 # forward-only Pallas warp has no interpret plumbing through this path;
 # smoke covers the harness with the other cases
-SMOKE_SKIP = {"warp_pallas_fwd"}
+SMOKE_SKIP = {"warp_pallas_fwd", "render_poses_pallas"}
 
 
 def _warp_inputs():
@@ -146,6 +152,37 @@ def _case_fn(case: str):
         else:
             fn = jax.jit(base)
         return fn, (rgb, sigma, xyz)
+
+    if case.startswith("render_poses_"):
+        from mine_tpu import geometry
+        from mine_tpu.ops import rendering
+        backend = case.rsplit("_", 1)[1]          # xla | pallas
+        warp_impl = "xla" if backend == "xla" else "pallas"
+        F = RENDER_POSES
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        rgb = jax.random.uniform(k1, (1, S, 3, H, W))
+        sigma = jax.random.uniform(k2, (1, S, 1, H, W)) * 5.0
+        disp = jnp.linspace(1.0, 0.05, S)[None]    # [1,S]
+        K = jnp.asarray(geometry.intrinsics_from_fov(H, W, 90.0))[None]
+        K_inv = geometry.inverse_intrinsics(K)
+        grid = geometry.cached_pixel_grid(H, W)
+        xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)
+        # straight-line dolly: small translations keep the warp in-band
+        ts = jnp.linspace(-0.05, 0.05, F)
+        G = jnp.broadcast_to(jnp.eye(4), (F, 4, 4)).at[:, 0, 3].set(ts)
+
+        def tile(x):
+            return jnp.broadcast_to(x, (F,) + x.shape[1:])
+
+        def render(rgb_, sigma_, G_):
+            xyz_tgt = geometry.plane_xyz_tgt(tile(xyz_src), G_)
+            res = rendering.render_tgt_rgb_depth(
+                tile(rgb_), tile(sigma_), tile(disp), xyz_tgt, G_,
+                tile(K_inv), tile(K), backend=backend,
+                warp_impl=warp_impl, warp_band=32)
+            return res.rgb, res.depth
+
+        return jax.jit(render), (rgb, sigma, G)
 
     raise ValueError(case)
 

@@ -71,4 +71,7 @@ unset MINE_TPU_BENCH_VARIANTS
 # 5. summarize the profile while the numbers are fresh
 run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true
 
+# 6. per-component + inference-chunk timings (kernel win/loss table)
+run_stage microbench 5400 python tools/microbench.py || true
+
 log "window done — see $OUT/bench_results.jsonl and $OUT/trace_summary.log"

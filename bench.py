@@ -45,6 +45,10 @@ Env knobs:
   MINE_TPU_BENCH_VARIANT_TIMEOUT seconds per variant incl. compile
                                  (default 1800)
   MINE_TPU_BENCH_CACHE           persistent compile-cache dir ('' disables)
+  MINE_TPU_BENCH_PEAK_TFLOPS     chip bf16 peak for the per-variant physics
+                                 audit (default 197 = v5e); readings whose
+                                 implied FLOP rate exceeds it are reported
+                                 as "suspect", never as the headline
 """
 
 import json
@@ -57,6 +61,11 @@ import time
 # Reference estimate: MINE on 2x V100 (B=2/GPU, fp32, 384x256, N=32).
 # See BASELINE.md "Estimated reference throughput" for the derivation.
 ESTIMATED_REFERENCE_IMAGES_PER_SEC = 4.0
+
+# bf16 peak of the one available chip (v5e) — the physics bound for the
+# per-variant sanity audit (see run-variant suspect check). Override if the
+# driver ever lands this on different hardware.
+CHIP_PEAK_TFLOPS = float(os.environ.get("MINE_TPU_BENCH_PEAK_TFLOPS", 197.0))
 
 SMOKE = os.environ.get("MINE_TPU_BENCH_SMOKE") == "1"
 HEIGHT, WIDTH = (64, 64) if SMOKE else (256, 384)
@@ -114,7 +123,11 @@ def _variant_config(name):
 
 
 def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
-    """Compile + run one variant; returns (images_per_sec, run_fn|None)."""
+    """Compile + run one variant.
+
+    Returns (images_per_sec, tflops_per_step|None, run_fn|None);
+    tflops_per_step is the HLO cost-analysis figure the parent uses to
+    reject physically-impossible readings (> chip peak)."""
     import jax
     import jax.numpy as jnp
 
@@ -125,6 +138,13 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
     state = trainer.init_state(batch_size=batch_size)
     batch = {k: jnp.asarray(v) for k, v in
              make_batch(batch_size, HEIGHT, WIDTH, num_points=256).items()}
+
+    tflops = None
+    try:
+        ca = trainer._train_step.lower(state, batch).cost_analysis()
+        tflops = ca.get("flops", 0.0) / 1e12 or None
+    except Exception:
+        pass  # cost analysis is advisory; never fail the measurement
 
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.train_step(state, batch)
@@ -149,7 +169,7 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
     print("  %s: %d steps in %.3fs (%.1f ms/step)"
           % (trainer.__class__.__name__, steps, dt, 1e3 * dt / steps),
           file=sys.stderr)
-    return batch_size * steps / dt, (run if keep_run else None)
+    return batch_size * steps / dt, tflops, (run if keep_run else None)
 
 
 # ---------------------------------------------------------------- child
@@ -191,16 +211,16 @@ def _child(name: str, outdir: str) -> None:
         config, batch = _variant_config(name)
         profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
         # the profile re-run only needs `run`; don't pay a full measurement
-        ips, run = _measure(config, batch,
-                            steps=1 if profile_dir else MEASURE_STEPS,
-                            keep_run=bool(profile_dir))
+        ips, tflops, run = _measure(config, batch,
+                                    steps=1 if profile_dir else MEASURE_STEPS,
+                                    keep_run=bool(profile_dir))
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
             run(5)
             jax.profiler.stop_trace()
             print("profiler trace (%s) in %s" % (name, profile_dir),
                   file=sys.stderr)
-        write({"ips": ips})
+        write({"ips": ips, "tflops_per_step": tflops, "batch": batch})
     except Exception as e:  # compile failure / OOM: record for the parent
         msg = (str(e).splitlines() or [repr(e)])[0][:200]
         write({"error": msg})
@@ -287,7 +307,27 @@ def _run_variant(name: str, env_extra=None):
         shutil.rmtree(outdir, ignore_errors=True)
     if payload is None:
         return None, err, wedged
+    err = None if SMOKE else audit_reading(
+        payload["ips"], payload.get("tflops_per_step"), payload.get("batch"))
+    if err is not None:
+        return None, err, False
     return payload["ips"], None, False
+
+
+def audit_reading(ips, tflops_per_step, batch):
+    """Physics audit of one variant reading; error string or None.
+
+    A reading whose implied FLOP rate exceeds the chip's peak is a
+    measurement artifact (observed once: 226 img/s => 256 TFLOP/s on a
+    ~197 TFLOP/s part), not a result — refuse to report it as one."""
+    if not tflops_per_step or not batch:
+        return None  # cost analysis unavailable: nothing to audit against
+    implied = ips / batch * tflops_per_step
+    if implied > CHIP_PEAK_TFLOPS:
+        return ("suspect: %.1f img/s implies %.0f TFLOP/s > %.0f peak "
+                "(%.2f TFLOP/step)"
+                % (ips, implied, CHIP_PEAK_TFLOPS, tflops_per_step))
+    return None
 
 
 def main():

@@ -103,3 +103,14 @@ def test_atomic_result_write_helper(tmp_path):
     with open(os.path.join(outdir, "result.json")) as f:
         assert json.load(f) == {"ips": 1.0}
     assert not os.path.exists(os.path.join(outdir, "result.json.tmp"))
+
+
+def test_physics_audit_rejects_above_peak_readings():
+    """The round-2 incident as a regression: 226.3 img/s at 4.526
+    TFLOP/step and B=4 implies 256 TFLOP/s > the 197 TFLOP/s peak."""
+    err = bench.audit_reading(226.3, 4.526, 4)
+    assert err is not None and err.startswith("suspect")
+    # a physically plausible reading passes (70 img/s => 79 TFLOP/s)
+    assert bench.audit_reading(70.0, 4.526, 4) is None
+    # no cost-analysis figure -> nothing to audit against
+    assert bench.audit_reading(226.3, None, 4) is None

@@ -139,22 +139,26 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
     batch = {k: jnp.asarray(v) for k, v in
              make_batch(batch_size, HEIGHT, WIDTH, num_points=256).items()}
 
+    # AOT: trace once, read the cost analysis off the lowering, compile the
+    # same lowering (avoids the second trace a fresh jit call would pay —
+    # tracing this step costs minutes on the 1-core host)
+    lowered = trainer._train_step.lower(state, batch)
     tflops = None
     try:
-        ca = trainer._train_step.lower(state, batch).cost_analysis()
-        tflops = ca.get("flops", 0.0) / 1e12 or None
+        tflops = lowered.cost_analysis().get("flops", 0.0) / 1e12 or None
     except Exception:
         pass  # cost analysis is advisory; never fail the measurement
+    step_fn = lowered.compile()
 
     for _ in range(WARMUP_STEPS):
-        state, metrics = trainer.train_step(state, batch)
+        state, metrics = step_fn(state, batch)
     jax.block_until_ready(metrics)
 
     def run(n):
         nonlocal state, metrics
         t0 = time.perf_counter()
         for _ in range(n):
-            state, metrics = trainer.train_step(state, batch)
+            state, metrics = step_fn(state, batch)
         # A real device->host readback of a computed value, not just
         # block_until_ready: the steps chain through `state`, so fetching
         # the LAST step's loss can only complete after every step's

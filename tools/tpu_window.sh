@@ -74,4 +74,21 @@ run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true
 # 6. per-component + inference-chunk timings (kernel win/loss table)
 run_stage microbench 5400 python tools/microbench.py || true
 
-log "window done — see $OUT/bench_results.jsonl and $OUT/trace_summary.log"
+# Persist results into the repo notes: the round driver commits uncommitted
+# work at round end, so numbers from an unattended window survive.
+{
+    echo ""
+    echo "## Auto-window results ($(date -u '+%Y-%m-%d %H:%MZ'), tools/tpu_window.sh)"
+    echo ""
+    echo '```'
+    echo "# bench variants (one JSON line per bench.py invocation)"
+    cat "$OUT/bench_results.jsonl" 2>/dev/null
+    echo "# kernel suites on device (tail)"
+    tail -3 "$OUT/kernel_tests.log" 2>/dev/null
+    echo "# microbench (ms/iter)"
+    tail -2 "$OUT/microbench.log" 2>/dev/null
+    echo "# trace summary (top ops)"
+    tail -15 "$OUT/trace_summary.log" 2>/dev/null
+    echo '```'
+} >> BENCH_NOTES_r02.md
+log "window done — results appended to BENCH_NOTES_r02.md"

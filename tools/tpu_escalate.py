@@ -75,6 +75,7 @@ def _stage_body(stage: str) -> None:
         jax.block_until_ready(metrics)
     elif stage == "pallas":
         from mine_tpu.kernels.warp import pallas_bilinear_sample
+        from mine_tpu.kernels.warp_vjp import bilinear_sample_diff
         src = jnp.ones((4, 7, 64, 128), jnp.float32)
         yy, xx = jnp.meshgrid(jnp.arange(64.0), jnp.arange(128.0),
                               indexing="ij")
@@ -82,6 +83,10 @@ def _stage_body(stage: str) -> None:
         cy = jnp.broadcast_to(yy[None] + 0.2, (4, 64, 128))
         out = pallas_bilinear_sample(src, cx, cy, band=16, interpret=False)
         jax.block_until_ready(out)
+        # the training pair: banded forward + transposed-band backward
+        g = jax.jit(jax.grad(
+            lambda s: jnp.sum(bilinear_sample_diff(s, cx, cy, 16, 16))))(src)
+        jax.block_until_ready(g)
     else:
         raise ValueError(stage)
 

@@ -30,6 +30,14 @@ log() { echo "[$(stamp)] $*" | tee -a "$OUT/window.log"; }
 
 run_stage() {
     name="$1"; tmo="$2"; shift 2
+    # cheap re-probe first: when the chip wedges mid-window, fail the
+    # remaining stages in ~2 min each instead of burning their full
+    # (multi-hour) timeouts on a dead tunnel
+    if ! timeout 120 python -c "import jax; jax.devices()" \
+            >/dev/null 2>&1; then
+        log "stage $name: SKIPPED (chip wedged at pre-probe)"
+        return 1
+    fi
     log "stage $name: $*"
     timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
     rc=$?

@@ -22,19 +22,39 @@
 
 set -u
 cd "$(dirname "$0")/.."
-OUT=/tmp/tpu_window
+
+# MINE_TPU_WINDOW_SMOKE=1: CPU dry-run of the PLUMBING (stage sequencing,
+# result aggregation, notes append) with tiny shapes — run after editing
+# this script so a bug never wastes a real chip window. Results go to a
+# scratch notes file, never the repo.
+SMOKE="${MINE_TPU_WINDOW_SMOKE:-}"
+OUT=/tmp/tpu_window${SMOKE:+_smoke}
+NOTES=${SMOKE:+/tmp/window_smoke_notes.md}
+NOTES=${NOTES:-BENCH_NOTES_r02.md}
+if [ -n "$SMOKE" ]; then
+    export MINE_TPU_BENCH_SMOKE=1 MINE_TPU_MICRO_SMOKE=1
+    export JAX_PLATFORMS=cpu
+    unset MINE_TPU_TESTS_ON_TPU 2>/dev/null || true
+fi
 mkdir -p "$OUT"
 stamp() { date +%H:%M:%S; }
 
 log() { echo "[$(stamp)] $*" | tee -a "$OUT/window.log"; }
+
+probe_cmd() {
+    if [ -n "$SMOKE" ]; then
+        timeout 120 python -c "import jax" >/dev/null 2>&1
+    else
+        timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+    fi
+}
 
 run_stage() {
     name="$1"; tmo="$2"; shift 2
     # cheap re-probe first: when the chip wedges mid-window, fail the
     # remaining stages in ~2 min each instead of burning their full
     # (multi-hour) timeouts on a dead tunnel
-    if ! timeout 120 python -c "import jax; jax.devices()" \
-            >/dev/null 2>&1; then
+    if ! probe_cmd; then
         log "stage $name: SKIPPED (chip wedged at pre-probe)"
         return 1
     fi
@@ -48,39 +68,53 @@ run_stage() {
 log "window start"
 
 # 0. quick probe — don't burn stage timeouts on a wedged chip
-run_stage probe 120 python -c "import jax; print(jax.devices())" || {
-    log "chip wedged; aborting window"; exit 1; }
+probe_cmd || { log "chip wedged; aborting window"; exit 1; }
 
 # 1. headline + profile (compile-cached after the first window)
-export MINE_TPU_BENCH_VARIANTS=xla_b4
+export MINE_TPU_BENCH_VARIANTS=${SMOKE:+xla_b2}
+export MINE_TPU_BENCH_VARIANTS=${MINE_TPU_BENCH_VARIANTS:-xla_b4}
 export MINE_TPU_BENCH_PROFILE="$OUT/prof"
 run_stage bench_headline 1500 python bench.py \
     && grep -h '^{' "$OUT/bench_headline.log" >> "$OUT/bench_results.jsonl"
 unset MINE_TPU_BENCH_PROFILE
 
-# 2. kernels on device (first compiled runs of the banded warp pair)
-export MINE_TPU_TESTS_ON_TPU=1
-run_stage kernel_tests 2400 \
-    python -m pytest tests/test_warp_kernel.py tests/test_warp_vjp.py \
-    tests/test_kernels.py tests/test_composite_vjp.py -x -q
-unset MINE_TPU_TESTS_ON_TPU
+# 2. kernels on device (first compiled runs of the banded warp pair);
+# in smoke: one interpret-mode file just to exercise the stage plumbing
+if [ -n "$SMOKE" ]; then
+    run_stage kernel_tests 2400 python -m pytest tests/test_kernels.py -x -q
+else
+    export MINE_TPU_TESTS_ON_TPU=1
+    run_stage kernel_tests 2400 \
+        python -m pytest tests/test_warp_kernel.py tests/test_warp_vjp.py \
+        tests/test_kernels.py tests/test_composite_vjp.py -x -q
+    unset MINE_TPU_TESTS_ON_TPU
+fi
 
 # 3. backend decision: Pallas + banded-XLA variants at the bench config
-export MINE_TPU_BENCH_VARIANTS=pallas_b4,xlabanded_b4
+export MINE_TPU_BENCH_VARIANTS=${SMOKE:+pallas_b2}
+export MINE_TPU_BENCH_VARIANTS=${MINE_TPU_BENCH_VARIANTS:-pallas_b4,xlabanded_b4}
 run_stage bench_backends 3600 python bench.py \
     && grep -h '^{' "$OUT/bench_backends.log" >> "$OUT/bench_results.jsonl"
 
-# 4. the rest of the sweep
-export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2
-run_stage bench_rest 5400 python bench.py \
-    && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
+# 4. the rest of the sweep (skipped in smoke — same code path as stage 3)
+if [ -z "$SMOKE" ]; then
+    export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2
+    run_stage bench_rest 5400 python bench.py \
+        && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
+fi
 unset MINE_TPU_BENCH_VARIANTS
 
 # 5. summarize the profile while the numbers are fresh
 run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true
 
-# 6. per-component + inference-chunk timings (kernel win/loss table)
-run_stage microbench 5400 python tools/microbench.py || true
+# 6. per-component + inference-chunk timings (kernel win/loss table);
+# smoke runs two cases to exercise the harness
+if [ -n "$SMOKE" ]; then
+    run_stage microbench 5400 python tools/microbench.py \
+        encoder_fwd comp_xla_fwd || true
+else
+    run_stage microbench 5400 python tools/microbench.py || true
+fi
 
 # Persist results into the repo notes: the round driver commits uncommitted
 # work at round end, so numbers from an unattended window survive.
@@ -98,5 +132,5 @@ run_stage microbench 5400 python tools/microbench.py || true
     echo "# trace summary (top ops)"
     tail -15 "$OUT/trace_summary.log" 2>/dev/null
     echo '```'
-} >> BENCH_NOTES_r02.md
-log "window done — results appended to BENCH_NOTES_r02.md"
+} >> "$NOTES"
+log "window done — results appended to $NOTES"

@@ -6,12 +6,19 @@
 # Keep the host otherwise idle while a window is running (BASELINE.md).
 LOG=/tmp/tpu_probe2.log
 cd "$(dirname "$0")/.."
+BUSY=/tmp/mine_tpu_host_busy
 while true; do
     ts=$(date +%H:%M:%S)
     if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-        echo "$ts OK - launching window" >> "$LOG"
-        sh tools/tpu_window.sh >> "$LOG" 2>&1
-        echo "$(date +%H:%M:%S) window finished" >> "$LOG"
+        if [ -f "$BUSY" ]; then
+            # measurements need an idle host (BASELINE.md): defer the
+            # window while a foreground CPU job holds the busy flag
+            echo "$ts OK but host busy ($BUSY exists) - deferring" >> "$LOG"
+        else
+            echo "$ts OK - launching window" >> "$LOG"
+            sh tools/tpu_window.sh >> "$LOG" 2>&1
+            echo "$(date +%H:%M:%S) window finished" >> "$LOG"
+        fi
     else
         echo "$ts WEDGED" >> "$LOG"
     fi

@@ -178,8 +178,10 @@ def homography_warp(src_BCHW: jnp.ndarray,
     valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
 
     if impl == "pallas":
+        from mine_tpu.kernels import on_tpu_backend
         from mine_tpu.kernels.warp import pallas_bilinear_sample
-        tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band)
+        tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band,
+                                     interpret=not on_tpu_backend())
     elif impl == "xla_banded":
         # banded one-hot-matmul warp in pure XLA (ops/warp_banded.py):
         # differentiable by autodiff and GSPMD-partitionable directly, so

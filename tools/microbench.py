@@ -47,9 +47,10 @@ CASES = [
     "render_poses_xla", "render_poses_pallas",
 ]
 RENDER_POSES = 2 if SMOKE else 8
-# forward-only Pallas warp has no interpret plumbing through this path;
-# smoke covers the harness with the other cases
-SMOKE_SKIP = {"warp_pallas_fwd", "render_poses_pallas"}
+# the forward-only Pallas warp paths run in interpret mode off-TPU
+# (ops/warp.py plumbs interpret=not on_tpu_backend()), so smoke covers
+# every case
+SMOKE_SKIP = set()
 
 
 def _warp_inputs():

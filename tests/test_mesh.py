@@ -1,0 +1,62 @@
+"""Mesh construction: flat single-slice path and DCN-aware multi-slice
+layout (data across slices, plane within a slice — the gradient all-reduce
+is the only collective that crosses DCN)."""
+
+import jax
+import numpy as np
+import pytest
+
+from mine_tpu.parallel import mesh as mesh_lib
+
+
+class _StubDev:
+    """Minimal TPU-like device: what mesh_utils' hybrid path reads."""
+
+    def __init__(self, i, slice_idx, coords):
+        self.id = i
+        self.slice_index = slice_idx
+        self.process_index = slice_idx
+        self.platform = "tpu"
+        self.device_kind = "stub"
+        self.coords = coords
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"D{self.id}s{self.slice_index}"
+
+
+def _two_slices_of_four():
+    coords = [(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)]
+    return [_StubDev(s * 4 + i, s, coords[i])
+            for s in range(2) for i in range(4)]
+
+
+def test_num_slices():
+    assert mesh_lib.num_slices(_two_slices_of_four()) == 2
+    # CPU/virtual devices carry no slice_index -> one slice
+    assert mesh_lib.num_slices(jax.devices()) == 1
+
+
+def test_flat_mesh_on_virtual_devices():
+    devs = jax.devices()[:8]
+    m = mesh_lib.make_mesh(data=4, plane=2, devices=devs)
+    assert m.devices.shape == (4, 2)
+    # single-slice path is a plain reshape: ordering preserved
+    assert list(m.devices.ravel()) == list(devs)
+
+
+def test_multislice_plane_axis_never_straddles_dcn():
+    m = mesh_lib.make_mesh(data=4, plane=2, devices=_two_slices_of_four())
+    arr = m.devices
+    assert arr.shape == (4, 2)
+    # every plane row lives entirely inside one slice (ICI-only collectives)
+    assert all(len({d.slice_index for d in row}) == 1 for row in arr)
+    # and the data axis actually spans both slices
+    assert {d.slice_index for d in arr[:, 0]} == {0, 1}
+
+
+def test_multislice_rejects_plane_straddling_dcn():
+    # data=1, plane=8 over 2 slices of 4: the single plane group would
+    # need devices from both slices -> refused
+    with pytest.raises(AssertionError, match="straddle"):
+        mesh_lib.make_mesh(data=1, plane=8, devices=_two_slices_of_four())

@@ -39,26 +39,45 @@ def multistep_lr(base_lr: float, decay_epochs, gamma: float,
 
 def make_optimizer(config: Dict[str, Any], steps_per_epoch: int) -> optax.GradientTransformation:
     """Two-group Adam(+L2) with MultiStepLR, matching the reference groups
-    {backbone: lr.backbone_lr, decoder: lr.decoder_lr} and lr.weight_decay."""
+    {backbone: lr.backbone_lr, decoder: lr.decoder_lr} and lr.weight_decay.
+
+    training.grad_accum_steps > 1 wraps the whole thing in optax.MultiSteps
+    (no reference equivalent — SURVEY.md section 2c "Gradient accumulation:
+    NO"; added because one v5e chip caps the per-step batch at B<=4 at LLFF
+    shapes, BENCH_NOTES_r02.md): every micro-batch goes through the normal
+    train_step, updates are emitted every k-th call with mean gradients,
+    and state.step stays in micro-batch units everywhere (logging,
+    checkpoint cadence, resume epoch math, current_lrs). The inner LR
+    schedule ticks once per OPTIMIZER step, so its epoch boundaries are
+    rescaled by 1/k to stay aligned with micro-step epochs. BN statistics
+    remain per micro-batch (the standard accumulation trade)."""
     wd = float(config.get("lr.weight_decay", 0.0))
     gamma = float(config.get("lr.decay_gamma", 0.1))
     decay_epochs = config.get("lr.decay_steps", [])
+    accum = int(config.get("training.grad_accum_steps", 1))
+    assert accum >= 1, accum
+    # optimizer steps per epoch (the inner schedule's clock)
+    opt_steps_per_epoch = max(1, steps_per_epoch // accum)
 
     def group(base_lr: float) -> optax.GradientTransformation:
         return optax.chain(
             optax.add_decayed_weights(wd),
             optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
             optax.scale_by_learning_rate(
-                multistep_lr(base_lr, decay_epochs, gamma, steps_per_epoch)),
+                multistep_lr(base_lr, decay_epochs, gamma,
+                             opt_steps_per_epoch)),
         )
 
     def label_fn(params):
         return {k: k for k in params}  # top-level keys: backbone / decoder
 
-    return optax.multi_transform(
+    tx = optax.multi_transform(
         {"backbone": group(float(config["lr.backbone_lr"])),
          "decoder": group(float(config["lr.decoder_lr"]))},
         label_fn)
+    if accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    return tx
 
 
 def create_train_state(model, config: Dict[str, Any], steps_per_epoch: int,

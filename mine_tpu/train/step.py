@@ -106,6 +106,8 @@ class SynthesisTrainer:
             mesh=mesh if (mesh is not None and mesh.size > 1) else None)
         self.remat, self.remat_policy = _remat_policy(
             config.get("training.remat", False))
+        self.grad_accum_steps = int(config.get("training.grad_accum_steps", 1))
+        assert self.grad_accum_steps >= 1, self.grad_accum_steps
         self.tx = make_optimizer(config, steps_per_epoch)
         self.lpips_params = lpips_params
 
@@ -204,8 +206,8 @@ class SynthesisTrainer:
 
     # ---------------- steps ----------------
 
-    def _train_step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
-        key = jax.random.fold_in(state.rng, state.step)
+    def _grads_and_metrics(self, state: TrainState, batch, key):
+        """One micro-batch's (grads, metrics, new_batch_stats)."""
         d_key, f_key, drop_key = jax.random.split(key, 3)
         B = batch["src_img"].shape[0]
         disparity = sample_disparity(d_key, B, self.cfg)
@@ -220,6 +222,11 @@ class SynthesisTrainer:
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        return grads, metrics, new_stats
+
+    def _train_step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        key = jax.random.fold_in(state.rng, state.step)
+        grads, metrics, new_stats = self._grads_and_metrics(state, batch, key)
         with jax.named_scope("adam_update"):
             updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                                     state.params)

@@ -230,3 +230,40 @@ def test_train_step_pallas_backends_on_mesh():
     p_moved = [float(np.abs(np.asarray(a) - b).max())
                for a, b in zip(jax.tree_util.tree_leaves(s2.params), p_before)]
     assert max(p_moved) > 0
+
+
+def test_grad_accum_matches_single_step_on_identical_micro_batches():
+    """training.grad_accum_steps=2 (optax.MultiSteps around the two-group
+    Adam): two train_steps over the SAME micro-batch must produce exactly
+    one single-step update — params frozen after the first (zero update
+    emitted mid-window), then updated with the mean gradient, which with
+    mpi.fix_disparity (no per-micro RNG) and no dropout equals the
+    single-batch gradient. Train-mode BN normalizes with current-batch
+    statistics, so running-stats updates between micro-steps cannot change
+    gradients."""
+    overrides = {"training.grad_accum_steps": 2, "mpi.fix_disparity": True}
+    batch = to_jnp(make_batch(1, 64, 64, num_points=16))
+
+    trainer = SynthesisTrainer(tiny_config(**overrides), steps_per_epoch=10)
+    assert trainer.grad_accum_steps == 2
+    state = trainer.init_state(batch_size=1, seed=3)
+    p0 = [np.asarray(x).copy()
+          for x in jax.tree_util.tree_leaves(state.params)]
+
+    state, m0 = trainer.train_step(state, batch)
+    assert int(state.step) == 1  # step stays in micro-batch units
+    for a, b in zip(jax.tree_util.tree_leaves(state.params), p0):
+        np.testing.assert_array_equal(np.asarray(a), b)  # mid-window: frozen
+
+    state, m1 = trainer.train_step(state, batch)
+    np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]), rtol=1e-6)
+
+    ref_trainer = SynthesisTrainer(tiny_config(**{"mpi.fix_disparity": True}),
+                                   steps_per_epoch=10)
+    ref_state = ref_trainer.init_state(batch_size=1, seed=3)
+    ref_state, _ = ref_trainer.train_step(ref_state, batch)
+
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(ref_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)

@@ -93,3 +93,19 @@ def test_train_loop_runs_epochs_evals_and_resumes(tmp_path):
     loop2 = TrainLoop(trainer, data, data, ws, logger=None, tb_writer=None)
     state2 = loop2.run(epochs=2)
     assert int(state2.step) == 8
+
+
+@pytest.mark.slow
+def test_train_epoch_grad_accum_runs(tmp_path):
+    """grad_accum_steps=2 through the unchanged TrainLoop (the accumulator
+    lives in opt_state via optax.MultiSteps): state.step counts
+    micro-batches; a window may span the epoch boundary harmlessly."""
+    cfg = tiny_config(**{"training.grad_accum_steps": 2})
+    cfg["data.per_gpu_batch_size"] = 1
+    data = SyntheticLoaderAdapter(num_views=6)  # 5 pairs -> 5 micro-batches
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=5)
+    loop = TrainLoop(trainer, data, None, str(tmp_path / "ws"),
+                     logger=None, tb_writer=None)
+    state = trainer.init_state(batch_size=1)
+    state = loop.train_epoch(state, epoch=0)
+    assert int(state.step) == 5

@@ -58,7 +58,16 @@ class CheckpointManager:
             return None
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           template)
-        return self._ckptr.restore(path, abstract)
+        try:
+            return self._ckptr.restore(path, abstract)
+        except Exception as e:
+            raise RuntimeError(
+                f"Checkpoint at {path} does not match the current "
+                "TrainState structure. This usually means the optimizer "
+                "config changed between runs (e.g. training.grad_accum_steps "
+                "toggled, which nests opt_state under optax.MultiSteps). "
+                "Resume with the original config, or load weights only via "
+                "training.pretrained_checkpoint_path (.npz).") from e
 
     def latest_exists(self) -> bool:
         return os.path.exists(self._path(LATEST_NAME))

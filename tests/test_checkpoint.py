@@ -67,3 +67,23 @@ def test_load_pretrained_params_partial(tmp_path):
         new_params["backbone"]["conv1"]["conv"]["kernel"], 1.0)
     np.testing.assert_allclose(new_params["backbone"]["bn1"]["bn"]["scale"], 1.0)
     np.testing.assert_allclose(new_stats["backbone"]["bn1"]["bn"]["mean"], 2.0)
+
+
+def test_restore_across_accum_config_change_raises_clearly(tmp_path):
+    """Toggling training.grad_accum_steps nests opt_state under
+    optax.MultiSteps; restoring an old checkpoint into the new structure
+    must fail with a message naming the cause, not an opaque tree error."""
+    import pytest
+
+    cfg = tiny_config()
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=10)
+    state = trainer.init_state(batch_size=1)
+    mgr = CheckpointManager(str(tmp_path / "ws"))
+    mgr.save_latest(state)
+    mgr.wait()
+
+    accum_trainer = SynthesisTrainer(
+        tiny_config(**{"training.grad_accum_steps": 2}), steps_per_epoch=10)
+    template = accum_trainer.init_state(batch_size=1)
+    with pytest.raises(RuntimeError, match="grad_accum_steps"):
+        mgr.restore(template)

@@ -33,6 +33,9 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    from mine_tpu.utils import configure_compile_cache
+    configure_compile_cache()
+
     import yaml
 
     from mine_tpu.config import CONFIG_DIR, load_config, postprocess

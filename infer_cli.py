@@ -32,6 +32,9 @@ def main():
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    from mine_tpu.utils import configure_compile_cache
+    configure_compile_cache()
+
     import cv2
     import numpy as np
     import yaml

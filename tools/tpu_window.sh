@@ -98,7 +98,7 @@ run_stage bench_backends 3600 python bench.py \
 
 # 4. the rest of the sweep (skipped in smoke — same code path as stage 3)
 if [ -z "$SMOKE" ]; then
-    export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2
+    export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2,xla_b2_ref512
     run_stage bench_rest 5400 python bench.py \
         && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
 fi

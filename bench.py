@@ -177,9 +177,8 @@ def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
         return time.perf_counter() - t0
 
     dt = run(steps)
-    print("  %s: %d steps in %.3fs (%.1f ms/step)"
-          % (trainer.__class__.__name__, steps, dt, 1e3 * dt / steps),
-          file=sys.stderr)
+    print("  measured %d steps in %.3fs (%.1f ms/step)"
+          % (steps, dt, 1e3 * dt / steps), file=sys.stderr)
     return batch_size * steps / dt, tflops, (run if keep_run else None)
 
 
@@ -197,11 +196,9 @@ def write_result(outdir, payload):
 def configure_cache():
     """Point JAX at the shared persistent compile cache (the escalate
     ladder's compiles are exactly the ones the benchmark reuses)."""
-    import jax
-    cache = os.environ.get("MINE_TPU_BENCH_CACHE", "/root/.cache/jax_bench")
-    if cache:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    from mine_tpu.utils import configure_compile_cache
+    configure_compile_cache(default_dir="/root/.cache/jax_bench",
+                            env_var="MINE_TPU_BENCH_CACHE")
 
 
 def _child(name: str, outdir: str) -> None:

@@ -54,15 +54,15 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
     """Grid step (b, source-row-block): splat OBAND gradient rows into RS
     source rows via transposed tent-weight contractions."""
     W_s = out_ref.shape[3]
+    b = pl.program_id(0)
+    sb = pl.program_id(1)
     # full [B', NBs] table in SMEM (a (1,1) block would violate the Mosaic
     # last-two-dims tiling rule); index it by grid step
-    o0 = o0_ref[pl.program_id(0), pl.program_id(1)]
-    sb = pl.program_id(1)
+    o0 = o0_ref[b, sb]
     h0 = (sb * RS).astype(jnp.float32)
 
     # g/xc/yc arrive as FULL arrays in HBM (ANY-space blocks must equal the
     # array shape); batch indexing happens here, the band via dynamic DMA
-    b = pl.program_id(0)
     dma_g = pltpu.make_async_copy(
         g_ref.at[b, :, pl.ds(o0, OBAND), :], g_buf, sem_g)
     dma_x = pltpu.make_async_copy(

@@ -50,21 +50,22 @@ def disparity_normalization_vis(disparity: np.ndarray) -> np.ndarray:
     return np.clip((d - dmin) / (dmax - dmin + 1e-12), 0.0, 1.0)
 
 
-def configure_compile_cache(default_dir: str = "~/.cache/mine_tpu_jax"):
-    """Enable JAX's persistent compile cache for the CLIs.
+def configure_compile_cache(default_dir: str = "~/.cache/mine_tpu_jax",
+                            env_var: str = "MINE_TPU_COMPILE_CACHE"):
+    """Enable JAX's persistent compile cache.
 
     First compile of the full train step costs minutes (remote-compiled on
     tunneled TPU backends); the cache makes every later invocation start in
-    seconds. MINE_TPU_COMPILE_CACHE overrides the directory; set it empty
-    to disable. bench.py keeps its own knob (MINE_TPU_BENCH_CACHE) so the
-    watchdog protocol's cache stays independently addressable.
+    seconds. `env_var` overrides the directory; set it empty to disable.
+    The CLIs use the default knob; bench.py passes its own
+    (MINE_TPU_BENCH_CACHE) so the watchdog protocol's cache stays
+    independently addressable.
     """
     import os
 
     import jax
 
-    cache = os.environ.get("MINE_TPU_COMPILE_CACHE",
-                           os.path.expanduser(default_dir))
+    cache = os.environ.get(env_var, os.path.expanduser(default_dir))
     if cache:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)

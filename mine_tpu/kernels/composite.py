@@ -60,6 +60,14 @@ def _pick_tile_h(H: int, W: int, S: int,
     return min(legal) if legal else H
 
 
+def pallas_tileable(H: int) -> bool:
+    """True when H admits a Mosaic-legal tile — a divisor that is a multiple
+    of 8, which exists iff 8 | H. Call-site guard: shapes where this is
+    False (e.g. H=756 full-res eval) must use the XLA composite — see
+    _pick_tile_h's docstring."""
+    return H % 8 == 0
+
+
 def _tgt_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
                 rgb_ref, sigma_ref, xyz_ref, rgb_out, depth_out):
     TH, W = rgb_ref.shape[3], rgb_ref.shape[4]

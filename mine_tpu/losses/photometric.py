@@ -51,14 +51,18 @@ def _instance_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     return (x - mean) / jnp.sqrt(var + eps)
 
 
-def psnr(img1: jnp.ndarray, img2: jnp.ndarray) -> jnp.ndarray:
-    """Mean PSNR over the batch for [0,1] images (network/layers.py:48-51)."""
+def psnr(img1: jnp.ndarray, img2: jnp.ndarray,
+         size_average: bool = True) -> jnp.ndarray:
+    """Mean PSNR over the batch for [0,1] images (network/layers.py:48-51).
+    size_average=False returns per-image PSNR [B] (masked-eval aggregation)."""
     mse = jnp.mean((img1 - img2) ** 2, axis=(1, 2, 3))
-    return jnp.mean(20.0 * jnp.log10(1.0 / jnp.sqrt(mse)))
+    per_image = 20.0 * jnp.log10(1.0 / jnp.sqrt(mse))
+    return jnp.mean(per_image) if size_average else per_image
 
 
 def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
-                    gmin: float, grad_ratio: float) -> jnp.ndarray:
+                    gmin: float, grad_ratio: float,
+                    size_average: bool = True) -> jnp.ndarray:
     """Edge-masked hinge smoothness on instance-normalized disparity
     gradients (network/layers.py:54-80).
 
@@ -84,10 +88,13 @@ def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
 
     loss_x = jax.nn.relu(grad_disp_x) * (1.0 - edge_mask_x)
     loss_y = jax.nn.relu(grad_disp_y) * (1.0 - edge_mask_y)
-    return jnp.mean(loss_x + loss_y)
+    if size_average:
+        return jnp.mean(loss_x + loss_y)
+    return jnp.mean(loss_x + loss_y, axis=(1, 2, 3))
 
 
-def edge_aware_loss_v2(img: jnp.ndarray, disp: jnp.ndarray) -> jnp.ndarray:
+def edge_aware_loss_v2(img: jnp.ndarray, disp: jnp.ndarray,
+                       size_average: bool = True) -> jnp.ndarray:
     """Classic monodepth2 edge-aware smoothness on mean-normalized disparity
     (network/layers.py:83-99).
 
@@ -106,4 +113,7 @@ def edge_aware_loss_v2(img: jnp.ndarray, disp: jnp.ndarray) -> jnp.ndarray:
 
     grad_d_x = grad_d_x * jnp.exp(-grad_i_x)
     grad_d_y = grad_d_y * jnp.exp(-grad_i_y)
-    return jnp.mean(grad_d_x) + jnp.mean(grad_d_y)
+    if size_average:
+        return jnp.mean(grad_d_x) + jnp.mean(grad_d_y)
+    return (jnp.mean(grad_d_x, axis=(1, 2, 3))
+            + jnp.mean(grad_d_y, axis=(1, 2, 3)))

@@ -60,11 +60,14 @@ class CheckpointManager:
                                           template)
         try:
             return self._ckptr.restore(path, abstract)
-        except Exception as e:
+        except (ValueError, KeyError, TypeError) as e:
+            # tree/structure mismatch out of orbax — almost always a config
+            # change between runs; surface the original error text so IO or
+            # corruption causes (which also raise ValueError) stay visible
             raise RuntimeError(
-                f"Checkpoint at {path} does not match the current "
-                "TrainState structure. This usually means the optimizer "
-                "config changed between runs (e.g. training.grad_accum_steps "
+                f"Failed to restore checkpoint at {path}: {e}\n"
+                "If this is a tree-structure mismatch, the optimizer config "
+                "likely changed between runs (e.g. training.grad_accum_steps "
                 "toggled, which nests opt_state under optax.MultiSteps). "
                 "Resume with the original config, or load weights only via "
                 "training.pretrained_checkpoint_path (.npz).") from e

@@ -83,8 +83,10 @@ def _safe_reciprocal_depth(depth: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray
 
 def _disp_loss(disp_syn_at_pts: jnp.ndarray, pt3d_disp: jnp.ndarray,
                scale_factor: jnp.ndarray) -> jnp.ndarray:
+    """Per-example sparse-disparity loss [B] (callers aggregate)."""
     scaled = disp_syn_at_pts / scale_factor[:, None, None]
-    return jnp.mean(jnp.abs(_safe_log(scaled) - _safe_log(pt3d_disp)))
+    return jnp.mean(jnp.abs(_safe_log(scaled) - _safe_log(pt3d_disp)),
+                    axis=(1, 2))
 
 
 def loss_per_scale(scale: int,
@@ -96,16 +98,26 @@ def loss_per_scale(scale: int,
                    scale_factor: Optional[jnp.ndarray],
                    mesh=None,
                    is_val: bool = False,
-                   lpips_params=None) -> Tuple[Dict[str, jnp.ndarray],
-                                               Dict[str, jnp.ndarray],
-                                               jnp.ndarray]:
+                   lpips_params=None,
+                   example_weight: Optional[jnp.ndarray] = None,
+                   ) -> Tuple[Dict[str, jnp.ndarray],
+                              Dict[str, jnp.ndarray],
+                              jnp.ndarray]:
     """One pyramid scale of the loss graph (synthesis_task.py:230-373).
 
     Args:
       mpi: [B,S,4,Hs,Ws] decoder output at this scale
       disparity: [B,S]
       scale_factor: [B] or None (computed here at scale 0)
+      example_weight: optional [B] weights for the batch-mean aggregation
+        (masked padded eval batches: 0-weight examples are excluded exactly;
+        jnp.where guards keep any garbage/NaN in padding examples out of the
+        weighted sum). None = plain batch mean (the training path).
     Returns: (loss_dict, visuals, scale_factor)
+
+    Every metric is computed per-example first ([B]) and then aggregated —
+    mathematically identical to the reference's whole-batch means because
+    all examples share one image size.
     """
     f = 2 ** scale
     src_imgs = nchw(batch["src_img"])[:, :, ::f, ::f]  # nearest pyramid
@@ -173,49 +185,68 @@ def loss_per_scale(scale: int,
     # ---- loss terms ----
     zero = jnp.zeros((), jnp.float32)
 
+    if example_weight is None:
+        agg = jnp.mean  # [B] per-example values -> batch mean
+    else:
+        w = example_weight
+        w_sum = jnp.maximum(jnp.sum(w), 1e-8)
+
+        def agg(v):
+            # where() first: 0-weight padding may hold NaN/inf and NaN*0=NaN
+            return jnp.sum(jnp.where(w > 0, v, 0.0) * w) / w_sum
+
+    def pex(x):  # per-example mean, [B,...] -> [B]
+        return jnp.mean(x, axis=tuple(range(1, x.ndim)))
+
     # src-view photometrics: logged, no gradient (synthesis_task.py:301-306)
-    loss_rgb_src = jax.lax.stop_gradient(jnp.mean(jnp.abs(src_syn - src_imgs)))
-    loss_ssim_src = jax.lax.stop_gradient(1.0 - ssim(src_syn, src_imgs))
+    loss_rgb_src = jax.lax.stop_gradient(agg(pex(jnp.abs(src_syn - src_imgs))))
+    loss_ssim_src = jax.lax.stop_gradient(
+        agg(1.0 - ssim(src_syn, src_imgs, size_average=False)))
     loss_smooth_src = jax.lax.stop_gradient(
-        edge_aware_loss(src_imgs, src_disp_syn,
-                        gmin=cfg.smoothness_gmin,
-                        grad_ratio=cfg.smoothness_grad_ratio))
+        agg(edge_aware_loss(src_imgs, src_disp_syn,
+                            gmin=cfg.smoothness_gmin,
+                            grad_ratio=cfg.smoothness_grad_ratio,
+                            size_average=False)))
 
     if cfg.use_disparity_loss:
-        loss_disp_src = _disp_loss(src_pt_disp_syn, src_pt_disp, scale_factor)
+        loss_disp_src = agg(_disp_loss(src_pt_disp_syn, src_pt_disp,
+                                       scale_factor))
         tgt_pt3d = batch["pt3d_tgt"]
         tgt_pt_disp = 1.0 / tgt_pt3d[:, 2:3]
         tgt_pt_pxpy = _project_points(K_tgt, tgt_pt3d)
         tgt_pt_disp_syn = sampling.gather_pixel_by_pxpy(tgt_disp_syn, tgt_pt_pxpy)
-        loss_disp_tgt = _disp_loss(tgt_pt_disp_syn, tgt_pt_disp, scale_factor)
+        loss_disp_tgt = agg(_disp_loss(tgt_pt_disp_syn, tgt_pt_disp,
+                                       scale_factor))
     else:
         loss_disp_src = zero
         loss_disp_tgt = zero
 
     # tgt rgb, masked to pixels covered by enough warped planes (:324-328)
     valid = (tgt_mask >= cfg.valid_mask_threshold).astype(jnp.float32)
-    loss_rgb_tgt = jnp.mean(jnp.abs(tgt_syn - tgt_imgs) * valid)
-    loss_ssim_tgt = 1.0 - ssim(tgt_syn, tgt_imgs)
+    loss_rgb_tgt = agg(pex(jnp.abs(tgt_syn - tgt_imgs) * valid))
+    loss_ssim_tgt = agg(1.0 - ssim(tgt_syn, tgt_imgs, size_average=False))
 
     if cfg.smoothness_lambda_v1 != 0.0:
-        loss_smooth_tgt = cfg.smoothness_lambda_v1 * edge_aware_loss(
+        loss_smooth_tgt = cfg.smoothness_lambda_v1 * agg(edge_aware_loss(
             tgt_imgs, tgt_disp_syn,
-            gmin=cfg.smoothness_gmin, grad_ratio=cfg.smoothness_grad_ratio)
+            gmin=cfg.smoothness_gmin, grad_ratio=cfg.smoothness_grad_ratio,
+            size_average=False))
     else:
         loss_smooth_tgt = zero
     if cfg.smoothness_lambda_v2 != 0.0:
-        loss_smooth_src_v2 = cfg.smoothness_lambda_v2 * edge_aware_loss_v2(
-            src_imgs, src_disp_syn)
-        loss_smooth_tgt_v2 = cfg.smoothness_lambda_v2 * edge_aware_loss_v2(
-            tgt_imgs, tgt_disp_syn)
+        loss_smooth_src_v2 = cfg.smoothness_lambda_v2 * agg(
+            edge_aware_loss_v2(src_imgs, src_disp_syn, size_average=False))
+        loss_smooth_tgt_v2 = cfg.smoothness_lambda_v2 * agg(
+            edge_aware_loss_v2(tgt_imgs, tgt_disp_syn, size_average=False))
     else:
         loss_smooth_src_v2 = zero
         loss_smooth_tgt_v2 = zero
 
-    psnr_tgt = jax.lax.stop_gradient(psnr(tgt_syn, tgt_imgs))
+    psnr_tgt = jax.lax.stop_gradient(
+        agg(psnr(tgt_syn, tgt_imgs, size_average=False)))
     if is_val and scale == 0:
         if lpips_params is not None:
-            lpips_tgt = jnp.mean(lpips_mod.lpips_distance(
+            lpips_tgt = agg(lpips_mod.lpips_distance(
                 lpips_params, tgt_syn, tgt_imgs))
         else:
             # absent weights must NOT read as a perfect 0.0 score — report
@@ -261,7 +292,8 @@ def compute_losses(mpi_list,
                    cfg: MPIConfig,
                    mesh=None,
                    is_val: bool = False,
-                   lpips_params=None):
+                   lpips_params=None,
+                   example_weight=None):
     """All scales + aggregation (synthesis_task.loss_fcn :375-401).
 
     Total = full term set at scale 0, plus per extra scale: rgb+ssim (if
@@ -277,7 +309,8 @@ def compute_losses(mpi_list,
     for scale in range(4):
         ld, vis, scale_factor = loss_per_scale(
             scale, mpi_list[scale], disparity, batch, G_tgt_src, cfg,
-            scale_factor, mesh=mesh, is_val=is_val, lpips_params=lpips_params)
+            scale_factor, mesh=mesh, is_val=is_val, lpips_params=lpips_params,
+            example_weight=example_weight)
         dicts.append(ld)
         if scale == 0:
             visuals0 = vis

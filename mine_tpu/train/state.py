@@ -31,9 +31,20 @@ class TrainState:
 
 
 def multistep_lr(base_lr: float, decay_epochs, gamma: float,
-                 steps_per_epoch: int) -> optax.Schedule:
-    """MultiStepLR: multiply by gamma at each epoch milestone."""
-    boundaries = {int(e) * int(steps_per_epoch): gamma for e in decay_epochs}
+                 steps_per_epoch: int, accum: int = 1) -> optax.Schedule:
+    """MultiStepLR: multiply by gamma at each epoch milestone.
+
+    With gradient accumulation the schedule's clock is OPTIMIZER steps, so
+    each epoch milestone is rounded from the micro-step product
+    (e * steps_per_epoch // accum), not from a truncated per-epoch quotient
+    — keeps the device schedule aligned with the host-side micro-step clock
+    (current_lrs) even when accum does not divide steps_per_epoch. When
+    several milestones land between the same two optimizer steps (accum >
+    steps_per_epoch) their gammas compound on that one boundary."""
+    boundaries: dict = {}
+    for e in decay_epochs:
+        b = int(e) * int(steps_per_epoch) // int(accum)
+        boundaries[b] = boundaries.get(b, 1.0) * gamma
     return optax.piecewise_constant_schedule(base_lr, boundaries)
 
 
@@ -56,8 +67,6 @@ def make_optimizer(config: Dict[str, Any], steps_per_epoch: int) -> optax.Gradie
     decay_epochs = config.get("lr.decay_steps", [])
     accum = int(config.get("training.grad_accum_steps", 1))
     assert accum >= 1, accum
-    # optimizer steps per epoch (the inner schedule's clock)
-    opt_steps_per_epoch = max(1, steps_per_epoch // accum)
 
     def group(base_lr: float) -> optax.GradientTransformation:
         return optax.chain(
@@ -65,7 +74,7 @@ def make_optimizer(config: Dict[str, Any], steps_per_epoch: int) -> optax.Gradie
             optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
             optax.scale_by_learning_rate(
                 multistep_lr(base_lr, decay_epochs, gamma,
-                             opt_steps_per_epoch)),
+                             steps_per_epoch, accum=accum)),
         )
 
     def label_fn(params):

@@ -25,3 +25,65 @@ if os.environ.get("MINE_TPU_TESTS_ON_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+# ---------------------------------------------------------------------------
+# Quick tier: `pytest -m quick` runs ONE cheap representative test per suite
+# (<2 min on a 1-core container) so the suite's health is independently
+# checkable without the ~37-min full run. Curated centrally here instead of
+# scattering marks across 33 files; tests/README.md documents the tier.
+# Suites whose every test compiles a full train step (train_variants,
+# train_loop, eval_cli, torch_parity) are represented by their cheapest
+# member only if it fits the budget — see QUICK below.
+# ---------------------------------------------------------------------------
+
+QUICK = {
+    "test_bench_watchdog.py::test_physics_audit_rejects_above_peak_readings",
+    "test_checkpoint.py::test_restore_missing_returns_none",
+    "test_composite_vjp.py::test_forward_values_match",
+    "test_config.py::test_load_llff_config_merges_defaults",
+    "test_convert.py::test_ref_key_matches_reference_tuple_to_str",
+    "test_data.py::test_colmap_binary_roundtrip",
+    "test_dtu.py::test_cam_parsing_and_rotation_angle",
+    "test_flowers.py::test_parse_cam_params",
+    "test_geometry.py::test_inverse_intrinsics_exact",
+    "test_infer.py::test_path_planning_straight_line",
+    "test_kernels.py::test_fused_volume_render_z_mask",
+    "test_kitti.py::test_calib_parsing_and_geometry",
+    "test_loop.py::test_average_meter",
+    "test_loss_aggregation.py::test_compute_scale_factor_formula",
+    "test_losses.py::test_psnr_analytic",
+    "test_mesh.py::test_num_slices",
+    "test_models.py::test_positional_encoding_matches_reference_formula",
+    "test_native_io.py::test_decode_resize_matches_pil",
+    "test_plane_scan.py::test_single_plane_shard_degenerates_to_serial",
+    "test_realestate10k.py::test_parse_camera_file",
+    "test_rendering.py::test_alpha_composition_two_planes",
+    "test_sampling.py::test_stratified_linspace_bins",
+    "test_train.py::test_multistep_lr_schedule",
+    "test_warp.py::test_homography_warp_identity",
+    "test_warp_banded.py::test_guard_falls_back_outside_domain",
+    "test_warp_kernel.py::test_band_span_helper",
+    "test_warp_vjp.py::test_domain_check_classifies",
+    "test_quick_tier.py::test_quick_entries_point_at_existing_tests",
+    "test_quick_tier.py::test_quick_tier_covers_most_suites",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: one cheap representative test per suite (<2 min)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest  # local: conftest imports before pytest plugins
+    for item in items:
+        # nodeid is like "tests/test_x.py::test_y[param]". A QUICK entry
+        # naming the bare test marks EVERY parametrization (keep such tests
+        # out of QUICK unless all cases are cheap); "test_y[param]" marks
+        # one case.
+        path_part, _, test_part = item.nodeid.partition("::")
+        nodeid = os.path.basename(path_part) + "::" + test_part
+        base = nodeid.split("[", 1)[0]
+        if nodeid in QUICK or base in QUICK:
+            item.add_marker(_pytest.mark.quick)

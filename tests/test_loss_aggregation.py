@@ -24,15 +24,23 @@ def test_compute_scale_factor_formula():
 
 
 def test_disp_loss_formula():
-    """disp loss = mean|log(syn/sf) - log(gt)| (synthesis_task.py:310-312)."""
+    """disp loss = mean|log(syn/sf) - log(gt)| (synthesis_task.py:310-312);
+    _disp_loss returns the per-example [B] means (callers batch-aggregate)."""
     syn = jnp.asarray([[[2.0, 2.0]]])
     gt = jnp.asarray([[[1.0, 1.0]]])
     sf = jnp.asarray([2.0])
+    out = loss_mod._disp_loss(syn, gt, sf)
+    assert out.shape == (1,)
+    np.testing.assert_allclose(float(out[0]), 0.0, atol=1e-6)
     np.testing.assert_allclose(
-        float(loss_mod._disp_loss(syn, gt, sf)), 0.0, atol=1e-6)
-    np.testing.assert_allclose(
-        float(loss_mod._disp_loss(syn, gt, jnp.asarray([1.0]))),
+        float(loss_mod._disp_loss(syn, gt, jnp.asarray([1.0]))[0]),
         np.log(2.0), rtol=1e-6)
+    # two examples -> independent per-example means
+    syn2 = jnp.asarray([[[2.0, 2.0]], [[4.0, 4.0]]])
+    gt2 = jnp.asarray([[[1.0, 1.0]], [[1.0, 1.0]]])
+    out2 = loss_mod._disp_loss(syn2, gt2, jnp.asarray([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(out2),
+                               [np.log(2.0), np.log(4.0)], rtol=1e-6)
 
 
 def test_project_points():

@@ -156,6 +156,38 @@ def test_render_tgt_behind_camera_sigma_zeroed():
     np.testing.assert_allclose(np.asarray(res.rgb), 0.0, atol=1e-5)
 
 
+def test_pallas_composite_untileable_h_falls_back_to_xla():
+    """Shapes whose H has no multiple-of-8 divisor (e.g. 756 full-res eval)
+    must route to the XLA composite rather than compile a full-height Pallas
+    block (ADVICE r2, kernels/composite.py:_pick_tile_h docstring)."""
+    from mine_tpu.kernels.composite import pallas_tileable
+    rng = np.random.RandomState(3)
+    B, S, H, W = 1, 3, 12, 8  # 12 has no multiple-of-8 divisor
+    assert not pallas_tileable(H) and pallas_tileable(W)
+    depths = [1.0, 2.0, 4.0]
+    disp = jnp.asarray(1.0 / np.asarray(depths, np.float32))[None]
+    K = jnp.asarray([[[10.0, 0, W / 2], [0, 10.0, H / 2], [0, 0, 1]]])
+    K_inv = geometry.inverse_intrinsics(K)
+    grid = geometry.pixel_grid_homogeneous(H, W)
+    xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)
+    rgb = jnp.asarray(rng.uniform(size=(B, S, 3, H, W)).astype(np.float32))
+    sigma = jnp.asarray(
+        rng.uniform(0.1, 2, size=(B, S, 1, H, W)).astype(np.float32))
+    G = jnp.tile(jnp.eye(4), (B, 1, 1))
+    xyz_tgt = geometry.plane_xyz_tgt(xyz_src, G)
+    ref = rendering.render_tgt_rgb_depth(rgb, sigma, disp, xyz_tgt, G,
+                                         K_inv, K, backend="xla")
+    out = rendering.render_tgt_rgb_depth(rgb, sigma, disp, xyz_tgt, G,
+                                         K_inv, K, backend="pallas_diff")
+    # the fallback must actually have routed (one-time warning key recorded)
+    assert any(k[0] == "pallas_diff" and "tile" in k[1]
+               for k in rendering._warned_fallbacks)
+    np.testing.assert_allclose(np.asarray(out.rgb), np.asarray(ref.rgb),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.depth), np.asarray(ref.depth),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_render_use_alpha_dispatch():
     B, S, H, W = 1, 3, 4, 4
     xyz = make_xyz(B, S, H, W, [1.0, 2.0, 3.0])

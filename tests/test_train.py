@@ -50,6 +50,26 @@ def test_multistep_lr_schedule():
     np.testing.assert_allclose(lrs["decoder"], 0.2)
 
 
+def test_multistep_lr_accum_boundaries():
+    """With grad accumulation the decay boundary is the ROUNDED product
+    e*steps_per_epoch//accum, not e*(steps_per_epoch//accum) — when accum
+    does not divide steps_per_epoch the truncated form fires the decay
+    early relative to the host micro-step clock (ADVICE r2)."""
+    # steps_per_epoch=10, accum=3: epoch-2 milestone = 20 micro = 6 opt steps
+    # (truncated per-epoch form would give 2*(10//3)=6 here too; epoch 4
+    # separates them: 40//3=13 vs 4*3=12)
+    sched = multistep_lr(1.0, [2, 4], 0.1, steps_per_epoch=10, accum=3)
+    np.testing.assert_allclose(float(sched(5)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(6)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(12)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(13)), 0.01, rtol=1e-6)
+    # accum > steps_per_epoch: milestones 3 and 6 micro-steps both precede
+    # the first optimizer step (8 micro) -> gammas compound on one boundary
+    # instead of one silently overwriting the other
+    sched2 = multistep_lr(1.0, [1, 2], 0.1, steps_per_epoch=3, accum=8)
+    np.testing.assert_allclose(float(sched2(1)), 0.01, rtol=1e-6)
+
+
 def test_optimizer_matches_torch_adam():
     """One Adam step with weight decay must match torch.optim.Adam (the
     reference optimizer, synthesis_task.py:83-87)."""

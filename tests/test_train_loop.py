@@ -38,18 +38,18 @@ def test_run_eval_counts_full_val_set(tmp_path):
 
 
 @pytest.mark.slow
-def test_run_eval_multihost_lockstep_caps_collective_calls(tmp_path,
-                                                          monkeypatch):
+def test_run_eval_multihost_covers_leftovers_with_masked_tail(tmp_path,
+                                                              monkeypatch):
     """With uneven per-host shards, every host must make the same number of
-    collective eval_step calls (min over hosts) — extra full batches go to
-    the leftover path instead of deadlocking the mesh jit. Simulated from
+    collective eval_step calls — 2 full + 1 padded masked tail here — and
+    NO example may be dropped (VERDICT r2 weak item 4). Simulated from
     host 0 of a fake 2-host world."""
     import mine_tpu.train.loop as loop_mod
 
     cfg = tiny_config()
     cfg["data.per_gpu_batch_size"] = 2
     # 11 items over 2 hosts: host0 gets 6 (3 full batches), host1 5 (2 full
-    # + remainder) -> common collective count is 2
+    # + remainder) -> common collective count is 2, leftover counts (2, 1)
     data = SyntheticLoaderAdapter(num_views=12)
     trainer = SynthesisTrainer(cfg, steps_per_epoch=5)
     loop = TrainLoop(trainer, data, data, str(tmp_path / "ws"),
@@ -57,9 +57,51 @@ def test_run_eval_multihost_lockstep_caps_collective_calls(tmp_path,
     monkeypatch.setattr(loop_mod.jax, "process_count", lambda: 2)
     state = trainer.init_state(batch_size=2)
     loop.run_eval(state)
-    # host0 evaluates exactly common_full=2 batches x 2 examples; its third
-    # full batch and nothing else goes through the (dropping) leftover path
-    assert loop.val_meters["loss"].count == 4
+    # host0's meters: 2 collective batches x global_bs=2, plus ONE masked
+    # tail batch counting the 3 valid leftover examples across both hosts
+    assert loop.val_meters["loss"].count == 7
+    assert np.isfinite(loop.val_meters["loss"].avg)
+
+
+@pytest.mark.slow
+def test_eval_step_masked_padding_invariant():
+    """Zero-weight padding examples must not influence masked eval metrics —
+    even NaN-poisoned padding (the where() guard in loss_per_scale)."""
+    import jax
+
+    from mine_tpu.data.synthetic import make_batch
+
+    cfg = tiny_config()
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=5)
+    state = trainer.init_state(batch_size=2)
+    key = jax.random.PRNGKey(7)
+
+    base = make_batch(2, 64, 64, num_points=32, seed=0)
+    w = np.asarray([1.0, 0.0], np.float32)
+
+    def metrics_with_padding(pad_fill):
+        b = {k: v.copy() for k, v in base.items()}
+        for k in ("src_img", "tgt_img"):
+            b[k][1] = pad_fill
+        m = trainer.eval_step_masked(
+            state, {k: np.asarray(v) for k, v in b.items()}, key,
+            np.asarray(w))
+        return {k: float(v) for k, v in m.items()}
+
+    m_garbage = metrics_with_padding(np.nan)
+    m_zeros = metrics_with_padding(0.0)
+    for k in m_garbage:
+        if k == "lpips_tgt":  # NaN sentinel without weights, by contract
+            continue
+        assert np.isfinite(m_garbage[k]), (k, m_garbage[k])
+        np.testing.assert_allclose(m_garbage[k], m_zeros[k], rtol=1e-6,
+                                   err_msg=k)
+
+    # and the weights actually select: full-weight metrics must differ
+    m_full = {k: float(v) for k, v in trainer.eval_step_masked(
+        state, {k: np.asarray(v) for k, v in base.items()}, key,
+        np.ones((2,), np.float32)).items()}
+    assert abs(m_full["loss"] - m_garbage["loss"]) > 1e-9
 
 
 @pytest.mark.slow

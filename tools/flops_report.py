@@ -74,7 +74,15 @@ def main():
             "at_40pct_mxu": round(0.4 * 4 * V5E_BF16_PEAK_TFLOPS / step, 1),
         },
     }
-    print(json.dumps(out if "--json" in sys.argv else out, indent=2))
+    # stdout JSON only under --json; the human-readable table already went
+    # to stderr line by line via add()
+    if "--json" in sys.argv:
+        print(json.dumps(out, indent=2))
+    else:
+        pb = out["peak_bound_images_per_sec"]
+        print("peak-bound img/s: %.1f @100%% MXU, %.1f @40%% (v5e %.0f TFLOP/s)"
+              % (pb["at_100pct_mxu"], pb["at_40pct_mxu"],
+                 pb["v5e_bf16_peak_tflops"]), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -99,7 +99,26 @@ def worker(process_id: int) -> None:
     restored = mgr.restore(trainer.init_state(trainer.global_batch_size()))
     assert restored is not None and int(restored.step) == 1
 
-    print(f"[proc {process_id}] step=1 loss={loss:.4f} OK", flush=True)
+    # multi-host run_eval must cover EVERY val example (VERDICT r2 weak
+    # item 4): 5 pairs over 2 hosts with local batch 1 -> stride shards of
+    # (3, 2), common collective count 2, so host0 has 1 leftover example
+    # that only the padded masked tail batch can reach. Both processes must
+    # count all 5 and agree on the metrics.
+    from mine_tpu.data.synthetic import SyntheticPairDataset
+    from mine_tpu.train.loop import TrainLoop
+
+    val = SyntheticPairDataset(num_views=6, num_points=16,
+                               height=64, width=64, seed=0)
+    loop = TrainLoop(trainer, val, val, os.path.join(ws, "loop_ws"),
+                     logger=None, tb_writer=None)
+    results = loop.run_eval(state)
+    eval_count = loop.val_meters["loss"].count
+    assert eval_count == len(val) == 5, eval_count
+    assert np.isfinite(results["loss"]), results
+
+    print(f"[proc {process_id}] step=1 loss={loss:.4f} "
+          f"eval_count={eval_count} eval_loss={results['loss']:.6f} OK",
+          flush=True)
     jax.distributed.shutdown()
 
 
@@ -155,6 +174,7 @@ def main() -> int:
                 p.kill()
 
     losses = []
+    eval_losses = []
     for pid, p in enumerate(procs):
         text = outputs[pid] or ""
         if p.returncode != 0:
@@ -162,19 +182,25 @@ def main() -> int:
             print(f"--- proc {pid} FAILED (rc={p.returncode}) ---")
             print(text[-4000:])
             continue
-        m = re.search(r"loss=([0-9.eE+-]+) OK", text)
+        m = re.search(r"loss=([0-9.eE+-]+) eval_count=5 "
+                      r"eval_loss=([0-9.eE+-]+) OK", text)
         if not m:
             ok = False
             print(f"--- proc {pid}: no loss line ---\n{text[-2000:]}")
             continue
         losses.append(float(m.group(1)))
-        print(f"[proc {pid}] loss={m.group(1)} OK")
+        eval_losses.append(float(m.group(2)))
+        print(f"[proc {pid}] loss={m.group(1)} eval_loss={m.group(2)} OK")
 
-    # the decisive multi-host invariant: both processes computed the SAME
-    # global loss from different local shards
+    # the decisive multi-host invariants: both processes computed the SAME
+    # global train loss from different local shards, and the SAME full-val
+    # eval average with nothing dropped
     if ok and (len(losses) != NPROC or abs(losses[0] - losses[1]) > 1e-6):
         ok = False
         print(f"loss mismatch across processes: {losses}")
+    if ok and abs(eval_losses[0] - eval_losses[1]) > 1e-6:
+        ok = False
+        print(f"eval loss mismatch across processes: {eval_losses}")
 
     if ok:
         print("MULTIPROCESS SMOKE OK")

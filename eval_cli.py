@@ -19,14 +19,14 @@ import json
 import os
 
 
-def main():
+def main(argv=None):
     parser = argparse.ArgumentParser(description="Evaluation")
     parser.add_argument("--checkpoint_path", type=str, required=True)
     parser.add_argument("--config_path", type=str, default=None)
     parser.add_argument("--extra_config", type=str, default="{}")
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="write a jax.profiler trace of the eval steps")
-    args = parser.parse_args()
+    args = parser.parse_args(argv)
 
     import jax
 
@@ -105,6 +105,7 @@ def main():
     if skipped:
         out["missing_metrics"] = skipped
     print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":

@@ -32,6 +32,7 @@ def test_quick_tier_covers_most_suites():
     step and would blow the <2 min budget)."""
     heavy_exempt = {
         "test_eval_cli.py",       # one end-to-end convert->eval CLI test
+        "test_parity_eval.py",    # one end-to-end parity-table test
         "test_torch_parity.py",   # full-model torch parity (minutes)
         "test_train_loop.py",     # every test runs the TrainLoop
         "test_train_variants.py", # every test jits a full train step

@@ -88,7 +88,10 @@ VARIANT_TIMEOUT = float(os.environ.get("MINE_TPU_BENCH_VARIANT_TIMEOUT",
 # 256x384 N=32 the decoder's B*S=256 activation volume exceeds the v5e's
 # 16 GB HBM and the axon tunnel degrades into a crawl that then wedges the
 # server-side grant (measured 2026-07-31: xla_b8 0.55 img/s, xla_b8_remat
-# 0.30 img/s, then the next child's PJRT init timed out). B<=4 fits.
+# 0.30 img/s, then the next child's PJRT init timed out). B<=4 fits. RAW
+# (unchunked) b8 variants stay banned; xla_b8_chunk4 below re-enters B=8
+# through plane-chunked decoding, which bounds the live activations to one
+# chunk.
 VARIANTS = {
     "xla_b4": (4, {}),                      # 226.3 img/s measured on v5e
     "pallas_b4": (4, {"training.warp_backend": "pallas_diff",
@@ -108,6 +111,16 @@ VARIANTS = {
     # configs/params_llff.yaml) for the apples-to-apples row; the headline
     # stays at the 384x256 north-star shape (BASELINE.json)
     "xla_b2_ref512": (2, {"data.img_h": 384, "data.img_w": 512}),
+    # coarse-to-fine on device (round-2 VERDICT item 10): the fine path
+    # (uniform coarse + pdf-sampled fine planes, mpi_rendering.py:244-271)
+    # was CPU-tested only. 32+32 planes at B=2 keeps B*S=128 = the b4 load.
+    "xla_b2_c2f": (2, {"mpi.num_bins_fine": 32}),
+    # B=8 re-entry via plane-chunked decoding (4 chunks of 8 planes, each
+    # under remat -> backward holds one chunk's activations; models/mpi.py).
+    # The raw b8 variants overflowed HBM and wedged the grant; this is the
+    # designed fix. Kept LAST in sweep order: if it still thrashes, the
+    # headline numbers are already on disk.
+    "xla_b8_chunk4": (8, {"training.decoder_plane_chunks": 4}),
 }
 
 

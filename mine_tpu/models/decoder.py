@@ -48,16 +48,47 @@ class MPIDecoder(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, features, disparity, train: bool):
+    def __call__(self, features, disparity, train: bool,
+                 neck_only: bool = False, neck_out=None):
         """
         Args:
           features: 5 NHWC encoder maps at strides 2/4/8/16/32
           disparity: [B, S]
+          neck_only: compute and return ONLY the receptive-field neck output
+            (batch B — plane-independent). The plane-chunked predictor calls
+            this once, then feeds the result back as `neck_out` to every
+            chunk call, so the neck isn't recomputed (and its BN running
+            stats aren't re-updated) per chunk.
+          neck_out: precomputed neck output (skips the neck modules' calls;
+            their params still exist from the neck_only call of the same
+            apply, so checkpoint structure is unchanged).
         Returns:
-          dict {scale: [B, S, 4, H_s, W_s] float32}, scale 0 = full res.
+          dict {scale: [B, S, 4, H_s, W_s] float32}, scale 0 = full res —
+          or the neck output [B, h, w, C] when neck_only.
         """
-        B, S = disparity.shape
         dd = features[-1].dtype if self.dtype is None else self.dtype
+
+        if neck_only or neck_out is None:
+            # receptive-field extension neck on the deepest feature
+            x = features[-1].astype(dd)
+            x = ConvBNLeaky(512, 1, dtype=self.dtype, name="conv_down1")(
+                max_pool_3x3_s2(x), train)
+            x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_down2")(
+                max_pool_3x3_s2(x), train)
+            x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_up1")(
+                upsample_nearest_2x(x), train)
+            x = ConvBNLeaky(self.num_ch_enc[-1], 1, dtype=self.dtype,
+                            name="conv_up2")(upsample_nearest_2x(x), train)
+            # The down/up round trip overshoots when H/32 is not a multiple
+            # of 4 (maxpool ceils, upsample doubles); crop back. No-op at
+            # the reference's training resolutions (H, W multiples of 128).
+            x = x[:, :features[-1].shape[1], :features[-1].shape[2], :]
+            if neck_only:
+                return x
+        else:
+            x = neck_out
+
+        B, S = disparity.shape
 
         emb = embedder.positional_encoding(
             disparity.reshape(B * S, 1).astype(jnp.float32),
@@ -76,21 +107,6 @@ class MPIDecoder(nn.Module):
             e = jnp.broadcast_to(emb[:, None, None, :],
                                  (B * S, h, w, emb.shape[-1]))
             return shard_bs(jnp.concatenate([f, e], axis=-1))
-
-        # receptive-field extension neck on the deepest feature
-        x = features[-1].astype(dd)
-        x = ConvBNLeaky(512, 1, dtype=self.dtype, name="conv_down1")(
-            max_pool_3x3_s2(x), train)
-        x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_down2")(
-            max_pool_3x3_s2(x), train)
-        x = ConvBNLeaky(256, 3, dtype=self.dtype, name="conv_up1")(
-            upsample_nearest_2x(x), train)
-        x = ConvBNLeaky(self.num_ch_enc[-1], 1, dtype=self.dtype, name="conv_up2")(
-            upsample_nearest_2x(x), train)
-        # The down/up round trip overshoots when H/32 is not a multiple of 4
-        # (maxpool ceils, upsample doubles); crop back. No-op at the
-        # reference's training resolutions (H, W multiples of 128).
-        x = x[:, :features[-1].shape[1], :features[-1].shape[2], :]
 
         x = expand_cat(x)  # replaces features[-1] as the decoder stem
 

@@ -107,14 +107,21 @@ def create_train_state(model, config: Dict[str, Any], steps_per_epoch: int,
 
 def current_lrs(config: Dict[str, Any], steps_per_epoch: int, step: int):
     """Host-side LR readback for logging (reference logs encoder lr,
-    synthesis_task.py:572)."""
+    synthesis_task.py:572). `step` is the micro-step clock (state.step);
+    with grad accumulation the decay lands on the optimizer-step boundary
+    e*spe//accum, which corresponds to micro-step (e*spe//accum)*accum —
+    mirrored here so the logged LR always equals the applied one."""
     gamma = float(config.get("lr.decay_gamma", 0.1))
     decay_epochs = config.get("lr.decay_steps", [])
+    accum = int(config.get("training.grad_accum_steps", 1))
     lrs = {}
     for name, key in (("backbone", "lr.backbone_lr"), ("decoder", "lr.decoder_lr")):
         lr = float(config[key])
         for e in decay_epochs:
-            if step >= int(e) * steps_per_epoch:
+            # piecewise_constant_schedule applies the scale for counts >=
+            # boundary (empirically: sched(boundary) is already decayed);
+            # the optimizer count at micro-step `step` is step // accum
+            if step // accum >= int(e) * steps_per_epoch // accum:
                 lr *= gamma
         lrs[name] = lr
     return lrs

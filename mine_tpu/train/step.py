@@ -103,7 +103,15 @@ class SynthesisTrainer:
             use_alpha=self.cfg.use_alpha,
             sigma_dropout_rate=self.cfg.sigma_dropout_rate,
             dtype=dtype,
-            mesh=mesh if (mesh is not None and mesh.size > 1) else None)
+            mesh=mesh if (mesh is not None and mesh.size > 1) else None,
+            plane_chunks=int(config.get("training.decoder_plane_chunks", 1)))
+        chunks = self.model.plane_chunks
+        if chunks > 1 and self.cfg.num_bins_coarse % chunks != 0:
+            # fail at construction, not as a silent unchunked (full-B*S HBM)
+            # run on the chip — the r2 grant wedge was exactly that footprint
+            raise ValueError(
+                f"training.decoder_plane_chunks={chunks} must divide "
+                f"mpi.num_bins_coarse={self.cfg.num_bins_coarse}")
         self.remat, self.remat_policy = _remat_policy(
             config.get("training.remat", False))
         self.grad_accum_steps = int(config.get("training.grad_accum_steps", 1))

@@ -68,6 +68,19 @@ def test_multistep_lr_accum_boundaries():
     # instead of one silently overwriting the other
     sched2 = multistep_lr(1.0, [1, 2], 0.1, steps_per_epoch=3, accum=8)
     np.testing.assert_allclose(float(sched2(1)), 0.01, rtol=1e-6)
+    # host-side readback (micro-step clock) must agree with the device
+    # schedule (optimizer-step clock) at EVERY micro-step, any accum
+    for accum, spe, miles in ((8, 3, [1, 2]), (3, 10, [2, 4]), (1, 10, [2, 4])):
+        cfg = {"lr.backbone_lr": 1.0, "lr.decoder_lr": 1.0,
+               "lr.decay_gamma": 0.1, "lr.decay_steps": miles,
+               "training.grad_accum_steps": accum}
+        sched_a = multistep_lr(1.0, miles, 0.1, steps_per_epoch=spe,
+                               accum=accum)
+        for micro in range(0, 50):
+            dev = float(sched_a(micro // accum))
+            host = current_lrs(cfg, spe, micro)["backbone"]
+            np.testing.assert_allclose(host, dev, rtol=1e-5,
+                                       err_msg=f"accum={accum} micro={micro}")
 
 
 def test_optimizer_matches_torch_adam():

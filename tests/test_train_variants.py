@@ -18,6 +18,22 @@ def _one_step(cfg, batch_size=1):
     return state, {k: float(v) for k, v in metrics.items()}
 
 
+def test_decoder_plane_chunks_step_close_to_unchunked():
+    """training.decoder_plane_chunks=2: the full train step runs and lands
+    near the unchunked loss. Not exact by design — each chunk normalizes by
+    its own BN batch statistics (ghost BN over B*S/chunks, models/mpi.py) —
+    so the tolerance is loose enough for BN-stat drift but tight enough to
+    catch mis-wired chunk plumbing."""
+    cfg = tiny_config()
+    cfg["mpi.num_bins_coarse"] = 4
+    _, m0 = _one_step(cfg)
+    cfg_c = dict(cfg)
+    cfg_c["training.decoder_plane_chunks"] = 2
+    _, m1 = _one_step(cfg_c)
+    assert np.isfinite(m1["loss"]), m1
+    np.testing.assert_allclose(m1["loss"], m0["loss"], rtol=0.05)
+
+
 def test_coarse_to_fine_step():
     """mpi.num_bins_fine > 0: importance-sampled extra planes, static shapes
     (mpi_rendering.predict_mpi_coarse_to_fine :244-271)."""

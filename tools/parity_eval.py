@@ -79,27 +79,27 @@ def main(argv=None):
         convert_main(["mine", "--src", ckpt, "--out", npz])
         ckpt = npz
 
-    # 2) LPIPS weights (optional; without them the metric is omitted, the
-    #    reference computes it always — synthesis_task.py:91-92). The env
-    #    var is how eval_cli locates weights; scope the mutation to this
-    #    call so an in-process caller's later evals can't silently reuse
-    #    stale weights.
-    lpips_prev = os.environ.get("MINE_TPU_LPIPS_WEIGHTS")
-    if args.lpips_vgg and args.lpips_lin:
-        lpips_npz = os.path.join(workdir, "lpips_vgg.npz")
-        convert_main(["lpips", "--vgg", args.lpips_vgg,
-                      "--lin", args.lpips_lin, "--out", lpips_npz])
-        os.environ["MINE_TPU_LPIPS_WEIGHTS"] = lpips_npz
-
-    # 3) the reference eval protocol through eval_cli
+    # 2+3) optional LPIPS weights (without them the metric is omitted; the
+    #    reference computes it always — synthesis_task.py:91-92), then the
+    #    reference eval protocol through eval_cli. The env var is how
+    #    eval_cli locates weights; the whole block sits under one
+    #    try/finally so NO exit path — conversion error, bad extra_config,
+    #    eval failure — can leak the mutation into an in-process caller's
+    #    later evals (which would silently reuse stale weights).
     config_yaml, data_name = DATASET_CONFIGS[args.dataset]
     extra = {"data.name": data_name}
     if args.dataset_path:
         extra["data.training_set_path"] = args.dataset_path
-    extra.update(json.loads(args.extra_config))
 
     import eval_cli
+    lpips_prev = os.environ.get("MINE_TPU_LPIPS_WEIGHTS")
     try:
+        if args.lpips_vgg and args.lpips_lin:
+            lpips_npz = os.path.join(workdir, "lpips_vgg.npz")
+            convert_main(["lpips", "--vgg", args.lpips_vgg,
+                          "--lin", args.lpips_lin, "--out", lpips_npz])
+            os.environ["MINE_TPU_LPIPS_WEIGHTS"] = lpips_npz
+        extra.update(json.loads(args.extra_config))
         results = eval_cli.main([
             "--checkpoint_path", ckpt,
             "--config_path", os.path.join(REPO, "mine_tpu", "configs",

@@ -9,10 +9,22 @@
 #
 #   1. headline bench (xla_b4, compile-cached from the last window) +
 #      jax.profiler trace -> the round's BENCH number and time attribution
-#   2. on-device kernel equivalence suites (the Pallas warp/composite
-#      kernels' numerics + VMEM fit on real hardware)
+#   2. forward kernel suites on device (numerics + VMEM fit) — the fast
+#      half; the heavier custom-VJP suites run later as stage 5
 #   3. Pallas-vs-XLA bench variants (the backend decision data)
-#   4. the rest of the sweep (clean b2 numbers etc.)
+#   4. the rest of the sweep (clean b2, reference-shape 512x384,
+#      coarse-to-fine at LLFF shapes)
+#   5. custom-VJP kernel suites (bwd numerics on silicon)
+#   6. B=8 re-entry via plane-chunked decoding — LAST of the chip-risky
+#      stages: the raw-b8 HBM overflow is what wedged the round-2 grant,
+#      so if chunking hasn't fixed it, everything above is already on disk
+#   7. trace summary (host-side digest of the stage-1 profile)
+#   8. microbench per-component timings
+#
+# Budget discipline (round-2 verdict item 9): stages 1+2 are capped at
+# ~15 min combined so even a short window yields the headline number and
+# kernel numerics before any sweep; the persistent compile cache makes
+# repeat windows mostly execution-bound.
 #
 # Stage logs land in /tmp/tpu_window/; bench JSON lines are appended to
 # /tmp/tpu_window/bench_results.jsonl. Keep the HOST IDLE while this
@@ -30,7 +42,7 @@ cd "$(dirname "$0")/.."
 SMOKE="${MINE_TPU_WINDOW_SMOKE:-}"
 OUT=/tmp/tpu_window${SMOKE:+_smoke}
 NOTES=${SMOKE:+/tmp/window_smoke_notes.md}
-NOTES=${NOTES:-BENCH_NOTES_r02.md}
+NOTES=${NOTES:-BENCH_NOTES_r03.md}
 if [ -n "$SMOKE" ]; then
     export MINE_TPU_BENCH_SMOKE=1 MINE_TPU_MICRO_SMOKE=1
     export JAX_PLATFORMS=cpu
@@ -70,44 +82,72 @@ log "window start"
 # 0. quick probe — don't burn stage timeouts on a wedged chip
 probe_cmd || { log "chip wedged; aborting window"; exit 1; }
 
-# 1. headline + profile (compile-cached after the first window)
+# Keep bench.py's own per-variant watchdog BELOW each stage's outer cap:
+# the watchdog converts an overrun into a recorded per-variant error line,
+# while an outer `timeout` kill loses the whole stage's JSON. init (240s)
+# + variant budget + overhead must fit inside the outer cap.
+
+# 1. headline + profile (compile-cached after the first window) — capped
+# with stage 2 so a short window still yields the headline + kernel
+# numerics before any sweep (verdict r2 item 9)
 export MINE_TPU_BENCH_VARIANTS=${SMOKE:+xla_b2}
 export MINE_TPU_BENCH_VARIANTS=${MINE_TPU_BENCH_VARIANTS:-xla_b4}
 export MINE_TPU_BENCH_PROFILE="$OUT/prof"
-run_stage bench_headline 1500 python bench.py \
+export MINE_TPU_BENCH_VARIANT_TIMEOUT=560
+run_stage bench_headline 900 python bench.py \
     && grep -h '^{' "$OUT/bench_headline.log" >> "$OUT/bench_results.jsonl"
 unset MINE_TPU_BENCH_PROFILE
 
-# 2. kernels on device (first compiled runs of the banded warp pair);
+# 2. forward kernel suites on device (fused composite + banded warp fwd);
 # in smoke: one interpret-mode file just to exercise the stage plumbing
 if [ -n "$SMOKE" ]; then
     run_stage kernel_tests 2400 python -m pytest tests/test_kernels.py -x -q
 else
     export MINE_TPU_TESTS_ON_TPU=1
-    run_stage kernel_tests 2400 \
-        python -m pytest tests/test_warp_kernel.py tests/test_warp_vjp.py \
-        tests/test_kernels.py tests/test_composite_vjp.py -x -q
+    run_stage kernel_tests 480 \
+        python -m pytest tests/test_kernels.py tests/test_warp_kernel.py -x -q
     unset MINE_TPU_TESTS_ON_TPU
 fi
 
 # 3. backend decision: Pallas + banded-XLA variants at the bench config
+# (2 variants x (240 init + 900 variant) < 2400 outer)
 export MINE_TPU_BENCH_VARIANTS=${SMOKE:+pallas_b2}
 export MINE_TPU_BENCH_VARIANTS=${MINE_TPU_BENCH_VARIANTS:-pallas_b4,xlabanded_b4}
-run_stage bench_backends 3600 python bench.py \
+export MINE_TPU_BENCH_VARIANT_TIMEOUT=900
+run_stage bench_backends 2400 python bench.py \
     && grep -h '^{' "$OUT/bench_backends.log" >> "$OUT/bench_results.jsonl"
 
-# 4. the rest of the sweep (skipped in smoke — same code path as stage 3)
+# 4. the rest of the sweep, incl. the reference-exact 512x384 shape and
+# the coarse-to-fine path at LLFF shapes (verdict r2 item 10); skipped in
+# smoke — same code path as stage 3
 if [ -z "$SMOKE" ]; then
-    export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2,xla_b2_ref512
-    run_stage bench_rest 5400 python bench.py \
+    # 7 variants x ~700s variant budget; init re-amortized per variant
+    export MINE_TPU_BENCH_VARIANTS=pallas_bf16_b4,xlabanded_bf16_b4,xla_bf16warp_b4,xla_b4_remat,xla_b2,xla_b2_ref512,xla_b2_c2f
+    export MINE_TPU_BENCH_VARIANT_TIMEOUT=700
+    run_stage bench_rest 7200 python bench.py \
         && grep -h '^{' "$OUT/bench_rest.log" >> "$OUT/bench_results.jsonl"
+
+    # 5. custom-VJP kernel suites (bwd numerics + VMEM fit on silicon)
+    export MINE_TPU_TESTS_ON_TPU=1
+    run_stage kernel_vjp_tests 1800 \
+        python -m pytest tests/test_warp_vjp.py tests/test_composite_vjp.py \
+        tests/test_warp_banded.py -x -q
+    unset MINE_TPU_TESTS_ON_TPU
+
+    # 6. B=8 via plane-chunked decoding — the round-2 HBM-overflow fix;
+    # LAST because a thrash here wedged the grant once already
+    export MINE_TPU_BENCH_VARIANTS=xla_b8_chunk4
+    export MINE_TPU_BENCH_VARIANT_TIMEOUT=1800
+    run_stage bench_b8_chunked 2400 python bench.py \
+        && grep -h '^{' "$OUT/bench_b8_chunked.log" >> "$OUT/bench_results.jsonl"
 fi
 unset MINE_TPU_BENCH_VARIANTS
+unset MINE_TPU_BENCH_VARIANT_TIMEOUT
 
-# 5. summarize the profile while the numbers are fresh
+# 7. summarize the profile while the numbers are fresh
 run_stage trace_summary 600 python tools/trace_summary.py "$OUT/prof" || true
 
-# 6. per-component + inference-chunk timings (kernel win/loss table);
+# 8. per-component + inference-chunk timings (kernel win/loss table);
 # smoke runs two cases to exercise the harness
 if [ -n "$SMOKE" ]; then
     run_stage microbench 5400 python tools/microbench.py \
@@ -127,6 +167,7 @@ fi
     cat "$OUT/bench_results.jsonl" 2>/dev/null
     echo "# kernel suites on device (tail)"
     tail -3 "$OUT/kernel_tests.log" 2>/dev/null
+    tail -3 "$OUT/kernel_vjp_tests.log" 2>/dev/null
     echo "# microbench (ms/iter)"
     tail -2 "$OUT/microbench.log" 2>/dev/null
     echo "# trace summary (top ops)"

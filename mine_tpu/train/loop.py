@@ -98,7 +98,12 @@ class TrainLoop:
         self.val_meters = {k: AverageMeter("val_" + k)
                            for k in TRAIN_METER_KEYS}
 
-        self.log_interval = int(self.config.get("training.log_interval", 10))
+        # meters update at log steps only (pulling metrics to host every
+        # step would sync the device pipeline); clamp so epochs shorter
+        # than the interval still log/meter instead of averaging nothing
+        self.log_interval = max(1, min(
+            int(self.config.get("training.log_interval", 10)),
+            trainer.steps_per_epoch))
         self.ckpt_interval = int(self.config.get("training.checkpoint_interval", 5000))
         self.eval_interval = int(self.config.get("training.eval_interval", 10000))
         # per-host examples per step (per_gpu_batch_size x data-axis devices,

@@ -106,12 +106,21 @@ class SynthesisTrainer:
             mesh=mesh if (mesh is not None and mesh.size > 1) else None,
             plane_chunks=int(config.get("training.decoder_plane_chunks", 1)))
         chunks = self.model.plane_chunks
-        if chunks > 1 and self.cfg.num_bins_coarse % chunks != 0:
+        if chunks > 1:
             # fail at construction, not as a silent unchunked (full-B*S HBM)
-            # run on the chip — the r2 grant wedge was exactly that footprint
-            raise ValueError(
-                f"training.decoder_plane_chunks={chunks} must divide "
-                f"mpi.num_bins_coarse={self.cfg.num_bins_coarse}")
+            # run or an opaque GSPMD sharding error on the chip — the r2
+            # grant wedge was exactly that footprint
+            if self.cfg.num_bins_coarse % chunks != 0:
+                raise ValueError(
+                    f"training.decoder_plane_chunks={chunks} must divide "
+                    f"mpi.num_bins_coarse={self.cfg.num_bins_coarse}")
+            plane = mesh.shape.get(mesh_lib.PLANE_AXIS, 1) if mesh else 1
+            if plane > 1 and (self.cfg.num_bins_coarse // chunks) % plane:
+                raise ValueError(
+                    f"chunk size {self.cfg.num_bins_coarse // chunks} "
+                    f"(= mpi.num_bins_coarse/{chunks}) must be divisible "
+                    f"by the mesh plane axis ({plane}) so each chunk's "
+                    f"B*S block still shards over ('data','plane')")
         self.remat, self.remat_policy = _remat_policy(
             config.get("training.remat", False))
         self.grad_accum_steps = int(config.get("training.grad_accum_steps", 1))

@@ -234,6 +234,40 @@ def test_train_step_sharded_matches_single_device():
     assert np.isfinite(float(m2["loss"]))
 
 
+def test_eval_step_masked_sharded_matches_single_device():
+    """The masked (padded-tail) eval jit on the 8-device mesh — the exact
+    program multi-host run_eval executes — must match the unsharded masked
+    eval: batch AND the [B] validity weight shard over 'data'."""
+    from mine_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    cfg = tiny_config()
+    cfg["data.per_gpu_batch_size"] = 4
+    batch = to_jnp(make_batch(4, 64, 64, num_points=16))
+    w = jnp.asarray([1.0, 1.0, 0.0, 1.0], jnp.float32)  # one padded slot
+    key = jax.random.PRNGKey(5)
+
+    t_single = SynthesisTrainer(cfg, steps_per_epoch=10)
+    s0 = t_single.init_state(batch_size=4)
+    m_single = {k: float(v) for k, v in
+                t_single.eval_step_masked(s0, batch, key, w).items()}
+
+    mesh = make_mesh(data=4, plane=2)
+    t_mesh = SynthesisTrainer(cfg, mesh=mesh, steps_per_epoch=10)
+    s1 = t_mesh.init_state(batch_size=4)
+    batch_m = t_mesh.put_batch({k: np.asarray(v) for k, v in batch.items()})
+    w_m = t_mesh.put_example_array(np.asarray(w))
+    m_mesh = {k: float(v) for k, v in
+              t_mesh.eval_step_masked(s1, batch_m, key, w_m).items()}
+
+    for k in m_single:
+        if np.isnan(m_single[k]):  # lpips sentinel
+            assert np.isnan(m_mesh[k]), k
+            continue
+        np.testing.assert_allclose(m_mesh[k], m_single[k], rtol=2e-3,
+                                   err_msg=k)
+
+
 def test_train_step_pallas_backends_on_mesh():
     """pallas_diff composite + warp compose with the multi-device mesh via
     shard_map (VERDICT r1 item 4 — the single-device guard is gone): the

@@ -66,6 +66,10 @@ def main(argv=None):
         parser.error("--lpips_vgg and --lpips_lin must be given together "
                      "(LPIPS needs both the VGG features and the linear "
                      "heads)")
+    try:  # fail on a malformed flag in ms, before any weight conversion
+        extra_overrides = json.loads(args.extra_config)
+    except json.JSONDecodeError as e:
+        parser.error(f"--extra_config is not valid JSON: {e}")
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="parity_eval_")
     os.makedirs(workdir, exist_ok=True)
@@ -99,7 +103,7 @@ def main(argv=None):
             convert_main(["lpips", "--vgg", args.lpips_vgg,
                           "--lin", args.lpips_lin, "--out", lpips_npz])
             os.environ["MINE_TPU_LPIPS_WEIGHTS"] = lpips_npz
-        extra.update(json.loads(args.extra_config))
+        extra.update(extra_overrides)
         results = eval_cli.main([
             "--checkpoint_path", ckpt,
             "--config_path", os.path.join(REPO, "mine_tpu", "configs",

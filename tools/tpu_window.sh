@@ -22,9 +22,9 @@
 #   8. microbench per-component timings
 #
 # Budget discipline (round-2 verdict item 9): stages 1+2 are capped at
-# ~15 min combined so even a short window yields the headline number and
-# kernel numerics before any sweep; the persistent compile cache makes
-# repeat windows mostly execution-bound.
+# 900s + 480s (~23 min worst-case with a cold compile cache; typically
+# far less once the persistent cache is warm) so even a short window
+# yields the headline number and kernel numerics before any sweep.
 #
 # Stage logs land in /tmp/tpu_window/; bench JSON lines are appended to
 # /tmp/tpu_window/bench_results.jsonl. Keep the HOST IDLE while this

@@ -5,7 +5,16 @@ from mine_tpu.kernels.composite import (fused_src_render_blend,  # noqa: F401
 def on_tpu_backend() -> bool:
     """True when the default JAX backend compiles Pallas TPU kernels natively
     ("tpu", or this container's "axon" tunnel); elsewhere kernels run in
-    interpret mode."""
+    interpret mode.
+
+    MINE_TPU_FORCE_TPU_KERNELS=1 forces native kernel lowering regardless
+    of backend — ONLY for `jax.export` TPU cross-lowering from a CPU host
+    (tools/tpu_crosscheck.py validates Mosaic legality of the exact window
+    programs without a chip). EXECUTING such a program on CPU fails."""
+    import os
+
+    if os.environ.get("MINE_TPU_FORCE_TPU_KERNELS") == "1":
+        return True
     import jax
 
     return jax.default_backend() in ("tpu", "axon")

@@ -1,0 +1,27 @@
+"""Gated wrapper for tools/tpu_crosscheck.py (full-step TPU cross-lowering
+of the risky bench variants — ~7 min of tracing on a 1-core host):
+
+    MINE_TPU_CROSSCHECK=1 python -m pytest tests/test_crosscheck.py -q
+
+Run it after touching the kernels, the decoder chunking, or the bench
+variant grid, BEFORE the next chip window."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("MINE_TPU_CROSSCHECK") != "1",
+                    reason="set MINE_TPU_CROSSCHECK=1 to cross-lower the "
+                           "bench variants for TPU (~7 min)")
+def test_bench_variants_cross_lower_for_tpu():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_crosscheck.py")],
+        capture_output=True, text=True, timeout=5400, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "all variants cross-lower for TPU" in proc.stdout

@@ -38,6 +38,7 @@ def test_quick_tier_covers_most_suites():
         "test_train_variants.py", # every test jits a full train step
         "test_plane_sharding.py", # mesh train-step compiles
         "test_multiprocess.py",   # env-gated 2-process job
+        "test_crosscheck.py",     # env-gated ~7-min TPU cross-lowering
     }
     files = {f for f in os.listdir(HERE)
              if f.startswith("test_") and f.endswith(".py")}

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Cross-lower bench variants' FULL train-step programs for TPU — no chip.
+
+`jax.export.export(..., platforms=["tpu"])` runs the complete TPU lowering
+pipeline (including Mosaic for Pallas kernels) on a CPU host. Round 2
+proved why this matters: the kernels' first real compile failed on three
+Mosaic rules that interpret-mode testing could not see. This tool extends
+that trick from isolated kernels to the exact programs `tools/tpu_window.sh`
+will launch — each bench variant's jitted train step at the REAL bench
+shapes — so a chip window never burns time discovering a lowering bug.
+
+What it validates: tracing, Mosaic legality, and StableHLO serialization of
+the whole step (fwd + 4-scale loss + bwd + Adam). What it cannot validate:
+TPU-backend compilation (VMEM fit, scheduling) or numerics — those remain
+window stages 2/5.
+
+Usage:
+    python tools/tpu_crosscheck.py [variant ...]   # default: risky set
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# variants whose lowering differs structurally from the already-proven
+# xla_b4 (pallas kernels at bench shapes, bf16 warp, plane-chunked b8,
+# coarse-to-fine); plain-XLA b2/b4 rows lower identically modulo shapes
+DEFAULT_VARIANTS = ("pallas_b4", "pallas_bf16_b4", "xla_b8_chunk4",
+                    "xla_b2_c2f")
+
+
+def main(argv=None):
+    os.environ["MINE_TPU_FORCE_TPU_KERNELS"] = "1"
+    # a leftover smoke switch would shrink every variant to 64x64 toy
+    # shapes and validate nothing the window will actually run
+    os.environ.pop("MINE_TPU_BENCH_SMOKE", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import bench
+
+    assert not bench.SMOKE, "crosscheck must lower the REAL bench shapes"
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.train.step import SynthesisTrainer
+
+    names = (argv if argv else sys.argv[1:]) or list(DEFAULT_VARIANTS)
+    failures = []
+    for name in names:
+        t0 = time.time()
+        config, B = bench._variant_config(name)
+        H = int(config["data.img_h"])
+        W = int(config["data.img_w"])
+        trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+        state = trainer.init_state(batch_size=B)
+        batch = {k: jnp.asarray(v) for k, v in
+                 make_batch(B, H, W, num_points=256).items()}
+        try:
+            # export the trainer's OWN jitted step (donate_argnums etc.),
+            # not a re-jit — the very callable bench._measure compiles
+            exp = jax.export.export(trainer._train_step,
+                                    platforms=["tpu"])(state, batch)
+            size = len(exp.mlir_module_serialized)
+            print(f"{name}: OK ({size / 1e6:.1f} MB stablehlo, "
+                  f"{time.time() - t0:.0f}s)", flush=True)
+        except Exception as e:
+            failures.append(name)
+            print(f"{name}: FAILED ({time.time() - t0:.0f}s)\n  {e}",
+                  flush=True)
+    if failures:
+        print("cross-lowering failures:", ", ".join(failures))
+        return 1
+    print("all variants cross-lower for TPU")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,23 +141,34 @@ def _variant_config(name):
     return config, batch
 
 
-def _measure(config, batch_size, steps=MEASURE_STEPS, keep_run=False):
+def build_variant_program(name):
+    """(trainer, state, batch) for a variant — THE program a measurement
+    runs. Shared with tools/tpu_crosscheck.py so pre-window TPU
+    cross-lowering validates exactly what the window compiles."""
+    import jax.numpy as jnp
+
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.train.step import SynthesisTrainer
+
+    config, batch_size = _variant_config(name)
+    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+    state = trainer.init_state(batch_size=batch_size)
+    h, w = int(config["data.img_h"]), int(config["data.img_w"])
+    batch = {k: jnp.asarray(v) for k, v in
+             make_batch(batch_size, h, w, num_points=256).items()}
+    return trainer, state, batch
+
+
+def _measure(name, steps=MEASURE_STEPS, keep_run=False):
     """Compile + run one variant.
 
     Returns (images_per_sec, tflops_per_step|None, run_fn|None);
     tflops_per_step is the HLO cost-analysis figure the parent uses to
     reject physically-impossible readings (> chip peak)."""
     import jax
-    import jax.numpy as jnp
 
-    from mine_tpu.data.synthetic import make_batch
-    from mine_tpu.train.step import SynthesisTrainer
-
-    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
-    state = trainer.init_state(batch_size=batch_size)
-    h, w = int(config["data.img_h"]), int(config["data.img_w"])
-    batch = {k: jnp.asarray(v) for k, v in
-             make_batch(batch_size, h, w, num_points=256).items()}
+    trainer, state, batch = build_variant_program(name)
+    batch_size = int(batch["src_img"].shape[0])
 
     # AOT: trace once, read the cost analysis off the lowering, compile the
     # same lowering (avoids the second trace a fresh jit call would pay —
@@ -229,10 +240,10 @@ def _child(name: str, outdir: str) -> None:
         jax.devices()  # blocks until the chip grant is acquired
         open(os.path.join(outdir, "INIT_OK"), "w").close()
 
-        config, batch = _variant_config(name)
+        _, batch = _variant_config(name)  # batch size, for the audit payload
         profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
         # the profile re-run only needs `run`; don't pay a full measurement
-        ips, tflops, run = _measure(config, batch,
+        ips, tflops, run = _measure(name,
                                     steps=1 if profile_dir else MEASURE_STEPS,
                                     keep_run=bool(profile_dir))
         if profile_dir:

@@ -268,6 +268,35 @@ def test_eval_step_masked_sharded_matches_single_device():
                                    err_msg=k)
 
 
+@pytest.mark.slow
+def test_plane_chunked_decoder_composes_with_mesh():
+    """decoder_plane_chunks (memory) x plane-sharded mesh (parallelism) —
+    the pod configuration for big batches: each chunk's B*S/k block still
+    shards over ('data','plane') and the step lands near the unchunked
+    mesh step (ghost-BN drift only)."""
+    from mine_tpu.parallel.mesh import make_mesh
+
+    cfg = tiny_config()
+    cfg["data.per_gpu_batch_size"] = 4
+    cfg["mpi.num_bins_coarse"] = 8
+    batch = to_jnp(make_batch(4, 64, 64, num_points=16))
+    mesh = make_mesh(data=4, plane=2)
+
+    t_plain = SynthesisTrainer(cfg, mesh=mesh, steps_per_epoch=10)
+    s0 = t_plain.init_state(batch_size=4)
+    _, m_plain = t_plain.train_step(s0, batch)
+
+    cfg_c = dict(cfg)
+    cfg_c["training.decoder_plane_chunks"] = 2  # chunk size 4, plane 2 | 4
+    t_chunk = SynthesisTrainer(cfg_c, mesh=mesh, steps_per_epoch=10)
+    s1 = t_chunk.init_state(batch_size=4)
+    _, m_chunk = t_chunk.train_step(s1, batch)
+
+    assert np.isfinite(float(m_chunk["loss"]))
+    np.testing.assert_allclose(float(m_chunk["loss"]),
+                               float(m_plain["loss"]), rtol=0.05)
+
+
 def test_train_step_pallas_backends_on_mesh():
     """pallas_diff composite + warp compose with the multi-device mesh via
     shard_map (VERDICT r1 item 4 — the single-device guard is gone): the

@@ -39,28 +39,26 @@ def main(argv=None):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     import bench
 
     assert not bench.SMOKE, "crosscheck must lower the REAL bench shapes"
-    from mine_tpu.data.synthetic import make_batch
-    from mine_tpu.train.step import SynthesisTrainer
 
     names = (argv if argv else sys.argv[1:]) or list(DEFAULT_VARIANTS)
+    unknown = sorted(set(names) - set(bench.VARIANTS))
+    if unknown:
+        print("unknown variants: %s (known: %s)"
+              % (", ".join(unknown), ", ".join(bench.VARIANTS)))
+        return 2
     failures = []
     for name in names:
         t0 = time.time()
-        config, B = bench._variant_config(name)
-        H = int(config["data.img_h"])
-        W = int(config["data.img_w"])
-        trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
-        state = trainer.init_state(batch_size=B)
-        batch = {k: jnp.asarray(v) for k, v in
-                 make_batch(B, H, W, num_points=256).items()}
         try:
-            # export the trainer's OWN jitted step (donate_argnums etc.),
-            # not a re-jit — the very callable bench._measure compiles
+            # bench.build_variant_program is THE program a measurement
+            # runs (trainer's own donated jit included) — shared so this
+            # check cannot drift from what the window compiles
+            trainer, state, batch = bench.build_variant_program(name)
+            # export the trainer's OWN jitted step (donate_argnums etc.)
             exp = jax.export.export(trainer._train_step,
                                     platforms=["tpu"])(state, batch)
             size = len(exp.mlir_module_serialized)

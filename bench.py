@@ -203,7 +203,8 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
     dt = run(steps)
     print("  measured %d steps in %.3fs (%.1f ms/step)"
           % (steps, dt, 1e3 * dt / steps), file=sys.stderr)
-    return batch_size * steps / dt, tflops, (run if keep_run else None)
+    return batch_size * steps / dt, tflops, (run if keep_run else None), \
+        batch_size
 
 
 # ---------------------------------------------------------------- child
@@ -240,12 +241,11 @@ def _child(name: str, outdir: str) -> None:
         jax.devices()  # blocks until the chip grant is acquired
         open(os.path.join(outdir, "INIT_OK"), "w").close()
 
-        _, batch = _variant_config(name)  # batch size, for the audit payload
         profile_dir = os.environ.get("MINE_TPU_BENCH_PROFILE")
         # the profile re-run only needs `run`; don't pay a full measurement
-        ips, tflops, run = _measure(name,
-                                    steps=1 if profile_dir else MEASURE_STEPS,
-                                    keep_run=bool(profile_dir))
+        ips, tflops, run, batch = _measure(
+            name, steps=1 if profile_dir else MEASURE_STEPS,
+            keep_run=bool(profile_dir))
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
             run(5)

@@ -23,30 +23,99 @@ LATEST_NAME = "checkpoint_latest"
 STEP_FMT = "checkpoint_%012d"
 
 
+# hard bound on waiting for an in-flight mirror upload before a save may
+# overwrite its source directory (or the process exits): past this the
+# uploader is killed and the incident logged — a hung remote store must
+# not wedge training (this repo's watchdog lesson applies to itself)
+MIRROR_REAP_TIMEOUT_S = 600.0
+
+
 class CheckpointManager:
-    def __init__(self, workspace: str):
+    def __init__(self, workspace: str, mirror_cmd: str = ""):
+        """`mirror_cmd`: optional shell command run (lead host only) after
+        each finished save, with the literal token `{path}` replaced by the
+        shell-quoted checkpoint directory — the generic counterpart of the
+        reference's hard-wired HDFS upload (synthesis_task.py:634-638).
+        E.g. `gsutil -m rsync -r {path} gs://bucket/ckpts/` or
+        `hdfs dfs -put -f {path} /ckpts/`. The upload runs detached; an
+        in-flight upload is reaped (bounded by MIRROR_REAP_TIMEOUT_S, then
+        killed) before a save may overwrite its source directory and at
+        wait(). Mirror problems log warnings, never raise."""
         self.workspace = os.path.abspath(workspace)
         os.makedirs(self.workspace, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        self.mirror_cmd = mirror_cmd
+        self._mirror_proc = None
 
     def _path(self, name: str) -> str:
         return os.path.join(self.workspace, name)
 
+    def _mirror(self, path: str):
+        """Launch the detached uploader for a finished save (lead host)."""
+        if not self.mirror_cmd or jax.process_index() != 0:
+            return
+        try:
+            import shlex
+            import subprocess
+            self._ckptr.wait_until_finished()  # files on disk before upload
+            # plain token replace + shell quoting: no str.format, so shell
+            # braces (${USER}, awk '{print}') in the command are untouched
+            cmd = self.mirror_cmd.replace("{path}", shlex.quote(path))
+            self._mirror_proc = (cmd, subprocess.Popen(
+                cmd, shell=True, start_new_session=True))
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint mirror launch failed", exc_info=True)
+
+    def _reap_mirror(self, block: bool = False):
+        """Collect the previous uploader; bounded kill when block=True."""
+        if self._mirror_proc is None:
+            return
+        import logging
+        import subprocess
+        cmd, proc = self._mirror_proc
+        try:
+            rc = proc.wait(MIRROR_REAP_TIMEOUT_S) if block else proc.poll()
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            logging.getLogger(__name__).warning(
+                "checkpoint mirror still running after %.0fs — killed: %s",
+                MIRROR_REAP_TIMEOUT_S, cmd)
+            self._mirror_proc = None
+            return
+        if rc is None:
+            return  # still running (non-blocking poll)
+        if rc != 0:
+            logging.getLogger(__name__).warning(
+                "checkpoint mirror command failed (rc=%d): %s", rc, cmd)
+        self._mirror_proc = None
+
     def save_latest(self, state: TrainState):
         """Rolling checkpoint (reference: checkpoint_latest.pth every 5000
         steps, synthesis_task.py:625-632)."""
+        # an in-flight mirror may still be reading checkpoint_latest;
+        # finish (or kill) it before force-overwriting its source
+        self._reap_mirror(block=True)
         path = self._path(LATEST_NAME)
         self._ckptr.save(path, state, force=True)
+        self._mirror(path)
 
     def save_step(self, state: TrainState):
         """Immutable per-eval checkpoint — unlike the reference's, it keeps
         the optimizer state (synthesis_task.py:650-652 drops it)."""
         path = self._path(STEP_FMT % int(state.step))
         if not os.path.exists(path):
+            self._reap_mirror(block=True)  # one uploader at a time
             self._ckptr.save(path, state)
+            self._mirror(path)
 
     def wait(self):
         self._ckptr.wait_until_finished()
+        # the final save's mirror must complete before the job exits, or
+        # container teardown kills the detached upload mid-transfer
+        self._reap_mirror(block=True)
 
     def restore(self, template: TrainState,
                 name: Optional[str] = None) -> Optional[TrainState]:

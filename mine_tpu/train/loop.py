@@ -90,7 +90,10 @@ class TrainLoop:
         self.val_dataset = val_dataset
         self.logger = logger
         self.tb = tb_writer
-        self.ckpt = CheckpointManager(workspace)
+        self.ckpt = CheckpointManager(
+            workspace,
+            mirror_cmd=str(self.config.get("training.checkpoint_mirror_cmd",
+                                           "") or ""))
 
         self.is_lead = jax.process_index() == 0
         self.train_meters = {k: AverageMeter("train_" + k)

@@ -87,3 +87,27 @@ def test_restore_across_accum_config_change_raises_clearly(tmp_path):
     template = accum_trainer.init_state(batch_size=1)
     with pytest.raises(RuntimeError, match="grad_accum_steps"):
         mgr.restore(template)
+
+
+def test_checkpoint_mirror_cmd(tmp_path):
+    """training.checkpoint_mirror_cmd: generic counterpart of the
+    reference's HDFS upload (synthesis_task.py:634-638) — runs after the
+    save is on disk, lead host only; failures log, never raise."""
+    cfg = tiny_config()
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=10)
+    state = trainer.init_state(batch_size=1)
+
+    dst = tmp_path / "mirror"
+    mgr = CheckpointManager(str(tmp_path / "ws"),
+                            mirror_cmd="cp -r {path} " + str(dst))
+    mgr.save_latest(state)
+    mgr._reap_mirror(block=True)
+    assert dst.exists() and any(dst.iterdir())  # real checkpoint files
+
+    # a failing mirror must not break training or subsequent saves
+    mgr_bad = CheckpointManager(str(tmp_path / "ws2"),
+                                mirror_cmd="false {path}")
+    mgr_bad.save_latest(state)
+    mgr_bad.save_step(state)  # reaps the failed one, launches the next
+    mgr_bad._reap_mirror(block=True)
+    assert mgr_bad.latest_exists()

@@ -29,11 +29,8 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     import bench
-    from mine_tpu.data.synthetic import make_batch
-    from mine_tpu.train.step import SynthesisTrainer
     from tools import microbench
 
     rows = {}
@@ -49,13 +46,9 @@ def main():
               % (name, rows[name]["tflops"],
                  rows[name]["gbytes_unfused_upper_bound"]), file=sys.stderr)
 
-    # full train step at the benchmark's headline variant
-    config, B = bench._variant_config("xla_b4")
-    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
-    state = trainer.init_state(batch_size=B)
-    batch = {k: jnp.asarray(v) for k, v in
-             make_batch(B, bench.HEIGHT, bench.WIDTH,
-                        num_points=256).items()}
+    # full train step at the benchmark's headline variant (shared builder:
+    # this attribution is of exactly the benchmarked program)
+    trainer, state, batch = bench.build_variant_program("xla_b4")
     add("train_step_b4", trainer._train_step_impl, state, batch)
 
     # isolated components at the microbench shapes (B=2, S=32, 256x384)

@@ -51,26 +51,25 @@ def _stage_body(stage: str) -> None:
                                        train=False))()
         out = jax.jit(lambda v, i: m.apply(v, i, train=False))(vars_, img)
         jax.block_until_ready(out)
-    elif stage in ("step18", "step50"):
+    elif stage == "step50":
         import bench
+        # byte-identical to the benchmark's xla_b2 variant — shared builder
+        trainer, state, batch = bench.build_variant_program("xla_b2")
+        state, metrics = trainer.train_step(state, batch)
+        jax.block_until_ready(metrics)
+    elif stage == "step18":
+        from mine_tpu.config import CONFIG_DIR, load_config
         from mine_tpu.data.synthetic import make_batch
         from mine_tpu.train.step import SynthesisTrainer
-        if stage == "step50":
-            # byte-identical to the benchmark's xla_b2 variant
-            config, B = bench._variant_config("xla_b2")
-            H, W = bench.HEIGHT, bench.WIDTH
-        else:
-            from mine_tpu.config import CONFIG_DIR, load_config
-            config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
-            config.update({"data.img_h": 128, "data.img_w": 128,
-                           "mpi.num_bins_coarse": 8, "model.num_layers": 18,
-                           "training.dtype": "bfloat16",
-                           "data.per_gpu_batch_size": 1})
-            B, H, W = 1, 128, 128
+        config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
+        config.update({"data.img_h": 128, "data.img_w": 128,
+                       "mpi.num_bins_coarse": 8, "model.num_layers": 18,
+                       "training.dtype": "bfloat16",
+                       "data.per_gpu_batch_size": 1})
         trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
-        state = trainer.init_state(batch_size=B)
+        state = trainer.init_state(batch_size=1)
         batch = {k: jnp.asarray(v) for k, v in
-                 make_batch(B, H, W, num_points=256).items()}
+                 make_batch(1, 128, 128, num_points=256).items()}
         state, metrics = trainer.train_step(state, batch)
         jax.block_until_ready(metrics)
     elif stage == "pallas":

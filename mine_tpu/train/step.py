@@ -90,6 +90,8 @@ class SynthesisTrainer:
         self.cfg = mpi_config_from_dict(config)
         self.mesh = mesh
         self.steps_per_epoch = steps_per_epoch
+        # (img_h/img_w multiple-of-32 validation lives in
+        # mpi_config_from_dict — shared with the inference entry point)
 
         # Pallas backends compose with multi-device meshes via shard_map
         # (ops/rendering.py, ops/warp.py): warp splits B*S over data*plane,

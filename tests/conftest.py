@@ -67,6 +67,7 @@ QUICK = {
     "test_warp_vjp.py::test_domain_check_classifies",
     "test_quick_tier.py::test_quick_entries_point_at_existing_tests",
     "test_quick_tier.py::test_quick_tier_covers_most_suites",
+    "test_make_scene.py::test_rotmat2qvec_roundtrip",
 }
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Build a trainable COLMAP/LLFF scene from images + known poses — no COLMAP.
+
+The reference's custom-data path expects COLMAP output (a `sparse/0` model
+next to the images; its vendored database.py/sqlite scripts exist to feed
+the COLMAP binary). When poses and intrinsics are already known — Blender /
+ARKit captures, robot rigs, synthetic renders — running COLMAP is a detour.
+This tool writes the sparse model directly through the tested clean-room
+writer (mine_tpu/data/colmap.py) in the exact layout data/llff.py loads:
+
+    <out>/sparse/0/{cameras,images,points3D}.bin
+    <out>/images/...            (+ every Nth image also in images_val/)
+
+Usage:
+  python tools/make_colmap_scene.py --images caps/ --poses poses.npy \
+      --points pts.npy --out scenes/myscene [--fov 60 | --intrinsics
+      fx,fy,cx,cy] [--pose_convention cam2world] [--val_every 8]
+
+  poses.npy: [N,4,4] float — world->cam extrinsics (COLMAP convention) by
+      default; --pose_convention cam2world inverts for you.
+  pts.npy:   [M,3] float world-space sparse points. Required: the training
+      losses gather per-image visible 3D points (scale factor + disparity
+      supervision, synthesis_task.py:211-220,310-312).
+
+Train with: data.name=llff, data.training_set_path=<parent of out>,
+data.img_pre_downsample_ratio=1 (images are stored full-res here).
+"""
+
+import argparse
+import glob
+import os
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mine_tpu.data import colmap  # noqa: E402
+
+IMG_EXTS = (".png", ".jpg", ".jpeg", ".JPG", ".PNG")
+
+
+def rotmat2qvec(R: np.ndarray) -> np.ndarray:
+    """[3,3] rotation -> (w,x,y,z) quaternion (Shepperd's method)."""
+    K = np.array([
+        [R[0, 0] - R[1, 1] - R[2, 2], 0, 0, 0],
+        [R[0, 1] + R[1, 0], R[1, 1] - R[0, 0] - R[2, 2], 0, 0],
+        [R[0, 2] + R[2, 0], R[1, 2] + R[2, 1],
+         R[2, 2] - R[0, 0] - R[1, 1], 0],
+        [R[2, 1] - R[1, 2], R[0, 2] - R[2, 0], R[1, 0] - R[0, 1],
+         R[0, 0] + R[1, 1] + R[2, 2]]]) / 3.0
+    vals, vecs = np.linalg.eigh(K)
+    q = vecs[[3, 0, 1, 2], np.argmax(vals)]
+    return -q if q[0] < 0 else q
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="images + poses (+ points) -> COLMAP/LLFF scene")
+    p.add_argument("--images", required=True, help="directory of images")
+    p.add_argument("--poses", required=True, help="[N,4,4] .npy extrinsics")
+    p.add_argument("--points", required=True, help="[M,3] .npy world points")
+    p.add_argument("--out", required=True, help="scene directory to create")
+    p.add_argument("--intrinsics", default=None,
+                   help="f,cx,cy (pixels, full-res; one isotropic focal — "
+                        "the LLFF loader parses SIMPLE_RADIAL cameras)")
+    p.add_argument("--fov", type=float, default=None,
+                   help="horizontal FoV in degrees (alternative to "
+                        "--intrinsics; principal point at the center)")
+    p.add_argument("--pose_convention", default="world2cam",
+                   choices=("world2cam", "cam2world"))
+    p.add_argument("--val_every", type=int, default=8,
+                   help="every Nth image is also a validation view")
+    args = p.parse_args(argv)
+    if (args.intrinsics is None) == (args.fov is None):
+        p.error("give exactly one of --intrinsics or --fov")
+    if args.val_every < 1:
+        p.error("--val_every must be >= 1")
+
+    paths = sorted(q for ext in IMG_EXTS
+                   for q in glob.glob(os.path.join(args.images, "*" + ext)))
+    if not paths:
+        p.error(f"no images under {args.images}")
+    poses = np.load(args.poses).astype(np.float64)
+    if poses.shape != (len(paths), 4, 4):
+        p.error(f"poses {poses.shape} != [{len(paths)},4,4] for "
+                f"{len(paths)} images")
+    if args.pose_convention == "cam2world":
+        poses = np.linalg.inv(poses)
+    pts = np.load(args.points).astype(np.float64)
+    if pts.ndim != 2 or pts.shape[1] != 3:
+        p.error(f"points must be [M,3], got {pts.shape}")
+
+    from PIL import Image as PILImage
+    with PILImage.open(paths[0]) as im:
+        W, H = im.size
+
+    if args.intrinsics:
+        parts = [float(v) for v in args.intrinsics.split(",")]
+        if len(parts) != 3:
+            p.error("--intrinsics must be f,cx,cy (a single isotropic "
+                    "focal: the LLFF loader reads SIMPLE_RADIAL cameras, "
+                    "which cannot represent fx != fy)")
+        f, cx, cy = parts
+    else:
+        f = (W / 2.0) / np.tan(np.radians(args.fov) / 2.0)
+        cx, cy = W / 2.0, H / 2.0
+    # SIMPLE_RADIAL (f, cx, cy, k=0): the layout data/llff.py parses
+    # (params[0]=f, params[1]=cx, params[2]=cy — llff.py:127-131)
+    cam = colmap.Camera(1, "SIMPLE_RADIAL", W, H,
+                        np.array([f, cx, cy, 0.0], np.float64))
+    K = np.array([[f, 0, cx], [0, f, cy], [0, 0, 1]])
+
+    images = {}
+    vis_all = np.zeros((len(paths), len(pts)), bool)  # [N,M] track matrix
+    for i, path in enumerate(paths):
+        R, t = poses[i, :3, :3], poses[i, :3, 3]
+        xyz_cam = R @ pts.T + t[:, None]           # [3,M]
+        proj = K @ xyz_cam
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xy = proj[:2] / proj[2:]
+        vis = ((xyz_cam[2] > 1e-6) & (xy[0] >= 0) & (xy[0] < W)
+               & (xy[1] >= 0) & (xy[1] < H))
+        vis_all[i] = vis
+        ids = np.where(vis, np.arange(len(pts), dtype=np.int64) + 1, -1)
+        images[i + 1] = colmap.Image(
+            i + 1, rotmat2qvec(R), t, 1, os.path.basename(path),
+            np.where(vis[:, None], xy.T, -1.0), ids)
+    min_vis = int(vis_all.sum(axis=1).min())
+
+    gray = np.array([128, 128, 128], np.uint8)
+    points3d = {}
+    for pid in range(len(pts)):  # tracks from the [N,M] matrix, one where()
+        track = np.where(vis_all[:, pid])[0]
+        points3d[pid + 1] = colmap.Point3D(
+            pid + 1, pts[pid], gray, 0.0,
+            (track + 1).astype(np.int32),
+            np.full(len(track), pid, np.int32))
+
+    sparse = os.path.join(args.out, "sparse", "0")
+    img_dir = os.path.join(args.out, "images")
+    val_dir = os.path.join(args.out, "images_val")
+    for d in (sparse, img_dir, val_dir):
+        os.makedirs(d, exist_ok=True)
+    colmap.write_model_binary(sparse, {1: cam}, images, points3d)
+    n_val = 0
+    for i, path in enumerate(paths):
+        shutil.copy(path, os.path.join(img_dir, os.path.basename(path)))
+        if i % args.val_every == 0:
+            shutil.copy(path, os.path.join(val_dir, os.path.basename(path)))
+            n_val += 1
+
+    # round-trip self-check through the reader the loader uses
+    cams_r, imgs_r, pts_r = colmap.read_model(sparse, ext=".bin")
+    assert len(imgs_r) == len(paths) and len(pts_r) == len(pts)
+    print(f"scene written: {args.out}\n"
+          f"  {len(paths)} images ({n_val} val), {len(pts)} points, "
+          f"min visible/view: {min_vis}\n"
+          f"  train with data.name=llff "
+          f"data.training_set_path={os.path.dirname(os.path.abspath(args.out))} "
+          f"data.img_pre_downsample_ratio=1")
+    if min_vis < 64:
+        print(f"  WARNING: only {min_vis} points visible in the worst view; "
+              f"data.visible_point_count must not exceed it")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

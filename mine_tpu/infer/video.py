@@ -146,10 +146,12 @@ class VideoGenerator:
         src_nchw = jnp.transpose(self.img, (0, 3, 1, 2))
         if self.backend == "pallas" and not self.cfg.use_alpha:
             # one fused pass: composite + src rgb blending + blended volume
+            from mine_tpu.kernels import on_tpu_backend
             from mine_tpu.kernels.composite import fused_src_render_blend
             _, _, self.mpi_rgb = fused_src_render_blend(
                 rgb, sigma, xyz_src, src_nchw,
-                is_bg_depth_inf=self.cfg.is_bg_depth_inf)
+                is_bg_depth_inf=self.cfg.is_bg_depth_inf,
+                interpret=not on_tpu_backend())
         else:
             _, _, blend_weights, _ = rendering.render(
                 rgb, sigma, xyz_src,

@@ -42,10 +42,11 @@ def _pick_tile_h(H: int, W: int, S: int,
     rows_per_plane = plane-sized f32 rows resident per spatial row (inputs +
     outputs + scratch); the backward kernel passes a larger value.
 
-    When H has NO divisor that is a multiple of 8 (e.g. H=756 full-res
-    eval), the only Mosaic-legal tile is H itself and the budget cannot be
-    honored — the resulting full-height block may exceed VMEM and fail to
-    compile. Such shapes should use the XLA composite path instead."""
+    Callers never pass an H with no multiple-of-8 divisor: every kernel
+    wrapper in this file pads rows to the next multiple of 8 first
+    (padded_rows_call), so a small legal tile always exists. The `H`
+    fallthrough below is only reachable if this function is reused on an
+    unpadded shape."""
     per_row = S * rows_per_plane * W * 4
     fit = min(max(1, budget // max(per_row, 1)), H)
     # Mosaic-legal tiles: divisors of H that are multiples of 8 (the f32
@@ -62,10 +63,27 @@ def _pick_tile_h(H: int, W: int, S: int,
 
 def pallas_tileable(H: int) -> bool:
     """True when H admits a Mosaic-legal tile — a divisor that is a multiple
-    of 8, which exists iff 8 | H. Call-site guard: shapes where this is
-    False (e.g. H=756 full-res eval) must use the XLA composite — see
-    _pick_tile_h's docstring."""
+    of 8, which exists iff 8 | H. Other heights (e.g. H=756 full-res eval)
+    are handled INSIDE every kernel wrapper here by zero-padding rows to
+    the next multiple of 8 and slicing the outputs — exact, because the
+    composite reduces over S with pixels independent across H."""
     return H % 8 == 0
+
+
+def pad_rows(x: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Zero-pad the H axis (second-to-last) of any (..., H, W) tensor."""
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)])
+
+
+def padded_rows_call(fn, arrs, pad: int, real_H: int, **kw):
+    """THE pad-call-slice rule, shared by every kernel wrapper: pad each
+    (..., H, W) arg's row axis by `pad`, call fn, slice every output back
+    to real_H. Exact because the composite kernels reduce over S with
+    pixels independent across H (padded rows: sigma=0 -> weight 0)."""
+    out = fn(*(pad_rows(a, pad) for a in arrs), **kw)
+    if isinstance(out, tuple):
+        return tuple(o[..., :real_H, :] for o in out)
+    return out[..., :real_H, :]
 
 
 def _tgt_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
@@ -110,8 +128,16 @@ def fused_volume_render(rgb_BS3HW: jnp.ndarray,
                         interpret: bool = False
                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fused equivalent of rendering.plane_volume_rendering (+ optional
-    behind-camera masking) returning (rgb [B,3,H,W], depth [B,1,H,W])."""
-    B, S, _, H, W = rgb_BS3HW.shape
+    behind-camera masking) returning (rgb [B,3,H,W], depth [B,1,H,W]).
+    Any H is accepted (rows padded to a Mosaic-legal multiple of 8)."""
+    B, S, _, real_H, W = rgb_BS3HW.shape
+    pad = (-real_H) % 8
+    if pad:
+        return padded_rows_call(
+            fused_volume_render, (rgb_BS3HW, sigma_BS1HW, xyz_BS3HW),
+            pad, real_H, z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
+            interpret=interpret)
+    H = real_H
     TH = _pick_tile_h(H, W, S)
     grid = (B, H // TH)
 
@@ -188,8 +214,17 @@ def fused_src_render_blend(rgb_BS3HW: jnp.ndarray,
     Equivalent to rendering.render + the blending block of the reference
     (synthesis_task.py:260-275). Returns (rgb [B,3,H,W], depth [B,1,H,W],
     blended mpi rgb [B,S,3,H,W] — the volume the novel-view warp consumes).
+    Any H is accepted (rows padded to a Mosaic-legal multiple of 8).
     """
-    B, S, _, H, W = rgb_BS3HW.shape
+    B, S, _, real_H, W = rgb_BS3HW.shape
+    pad = (-real_H) % 8
+    if pad:
+        return padded_rows_call(
+            fused_src_render_blend,
+            (rgb_BS3HW, sigma_BS1HW, xyz_BS3HW, src_img_B3HW),
+            pad, real_H, is_bg_depth_inf=is_bg_depth_inf,
+            interpret=interpret)
+    H = real_H
     TH = _pick_tile_h(H, W, S)
     grid = (B, H // TH)
 

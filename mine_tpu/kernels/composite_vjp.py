@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mine_tpu.kernels.composite import _pick_tile_h, fused_volume_render
+from mine_tpu.kernels.composite import (_pick_tile_h, fused_volume_render,
+                                        padded_rows_call)
 
 
 def _pick_tile_h_bwd(H: int, W: int, S: int) -> int:
@@ -125,7 +126,16 @@ def _bwd_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
 def _composite_bwd(rgb, sigma, xyz, g_rgb, g_depth,
                    z_mask: bool, is_bg_depth_inf: bool,
                    interpret: bool = False):
-    B, S, _, H, W = rgb.shape
+    B, S, _, real_H, W = rgb.shape
+    pad = (-real_H) % 8
+    if pad:
+        # padded rows carry sigma=0 and zero cotangents: their grads are 0
+        # and the real rows' grads are untouched (pixels independent over H)
+        return padded_rows_call(
+            _composite_bwd, (rgb, sigma, xyz, g_rgb, g_depth), pad, real_H,
+            z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
+            interpret=interpret)
+    H = real_H
     TH = _pick_tile_h_bwd(H, W, S)
     grid = (B, H // TH)
 

@@ -223,14 +223,9 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                                "alpha-compositing path")
         backend = "xla"
 
-    if backend in ("pallas", "pallas_diff"):
-        from mine_tpu.kernels.composite import pallas_tileable
-        if not pallas_tileable(H):
-            # no divisor of H is a multiple of 8 => the only Mosaic-legal
-            # block is full-height, which can blow VMEM (H=756 eval shapes)
-            _warn_backend_fallback(
-                backend, f"H={H} has no multiple-of-8 tile; XLA composite")
-            backend = "xla"
+    # Arbitrary heights are fine on the Pallas backends: the kernel
+    # wrappers pad rows to a Mosaic-legal multiple of 8 internally
+    # (kernels/composite.py pad_rows) and slice the outputs.
 
     if backend == "plane_scan":
         from mine_tpu.ops.plane_scan import plane_sharded_volume_render

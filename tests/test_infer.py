@@ -65,6 +65,16 @@ def test_video_generator_end_to_end(tmp_path):
     # identity pose reproduces the blended source composite closely
     assert np.abs(rgb[0] - rgb[0].clip(0, 1)).max() < 1e-5
 
+    # explicit pallas backend must run off-TPU too (interpret mode —
+    # regression: the fused src-blend call once omitted the interpret flag
+    # and crashed on CPU) and agree with the XLA encode
+    gen_p = VideoGenerator(cfg, variables["params"],
+                           variables["batch_stats"], img, chunk=4,
+                           dtype=None, backend="pallas")
+    np.testing.assert_allclose(np.asarray(gen_p.mpi_rgb),
+                               np.asarray(gen.mpi_rgb),
+                               rtol=1e-5, atol=1e-5)
+
     # near-identity trajectories sit inside the Pallas warp band: the span
     # is the row-block's own 8-row extent (7) + small translation slope
     span = gen._max_row_block_span(poses)

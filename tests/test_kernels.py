@@ -81,6 +81,42 @@ def test_fused_src_render_blend_matches_two_pass_xla():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_kernel_wrappers_accept_untileable_heights():
+    """Every kernel wrapper self-pads rows for H with no multiple-of-8
+    divisor (H=12 here; eval/infer full-res heights like 756 in the wild)
+    and stays exact vs the XLA path — incl. fused_src_render_blend, the
+    inference entry the call-site-level padding missed."""
+    rgb, sigma, xyz = _volume(4, H=12, W=16)
+    B, S, _, H, W = rgb.shape
+    interp = kernel_test_utils.interpret()
+
+    ref_rgb, ref_depth, blend_w, weights = rendering.plane_volume_rendering(
+        rgb, sigma, xyz, False)
+    out_rgb, out_depth = fused_volume_render(rgb, sigma, xyz,
+                                             interpret=interp)
+    assert out_rgb.shape == (B, 3, H, W)
+    np.testing.assert_allclose(np.asarray(out_rgb), np.asarray(ref_rgb),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_depth), np.asarray(ref_depth),
+                               rtol=1e-4, atol=1e-5)
+
+    src = jnp.asarray(np.random.RandomState(5).uniform(
+        size=(B, 3, H, W)).astype(np.float32))
+    blended_ref = blend_w * src[:, None] + (1.0 - blend_w) * rgb
+    sref_rgb, sref_depth = rendering.weighted_sum_mpi(
+        blended_ref, xyz, weights, False)
+    s_rgb, s_depth, s_blended = fused_src_render_blend(
+        rgb, sigma, xyz, src, interpret=interp)
+    assert s_blended.shape == (B, S, 3, H, W)
+    np.testing.assert_allclose(np.asarray(s_blended),
+                               np.asarray(blended_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s_rgb), np.asarray(sref_rgb),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s_depth), np.asarray(sref_depth),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_tile_h_picker():
     from mine_tpu.kernels.composite import _pick_tile_h
 

@@ -139,20 +139,24 @@ class MPIConfig:
 _NO_DISP_DATASETS = ("flowers", "kitti_raw", "dtu")
 
 
+def validate_model_shapes(cfg: "MPIConfig") -> None:
+    """The encoder taps strides 2..32 and the decoder's upsample ladder
+    doubles back up — non-multiple-of-32 shapes desync the skip concats
+    deep in the graph (opaque concatenate errors). Model consumers
+    (SynthesisTrainer, VideoGenerator) call this; dataset loaders don't,
+    since loader-side resizing has no stride constraint."""
+    for k in ("img_h", "img_w"):
+        v = int(getattr(cfg, k))
+        if v % 32 != 0:
+            raise ValueError(
+                f"data.{k}={v} must be a multiple of 32 (encoder stride-32 "
+                f"taps + decoder upsample ladder); nearest valid: "
+                f"{v // 32 * 32} or {-(-v // 32) * 32}")
+
+
 def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
     g = config.get
     name = g("data.name", "llff")
-    # the encoder taps strides 2..32 and the decoder's upsample ladder
-    # doubles back up — non-multiple-of-32 shapes desync the skip concats
-    # deep in the graph (opaque concatenate errors). Validate here so BOTH
-    # entry points (trainer and inference) reject them with the fix named.
-    for k in ("data.img_h", "data.img_w"):
-        v = int(g(k, 256))
-        if v % 32 != 0:
-            raise ValueError(
-                f"{k}={v} must be a multiple of 32 (encoder stride-32 taps "
-                f"+ decoder upsample ladder); nearest valid: "
-                f"{v // 32 * 32} or {-(-v // 32) * 32}")
     backend = g("training.composite_backend", "xla")
     # "pallas" (forward-only) is an internal render-path backend; the training
     # loss graph differentiates through the composite, so only the custom-VJP

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from mine_tpu import geometry
-from mine_tpu.config import MPIConfig, mpi_config_from_dict
+from mine_tpu.config import (MPIConfig, mpi_config_from_dict,
+                             validate_model_shapes)
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering
 from mine_tpu.train.step import sample_disparity
@@ -112,6 +113,7 @@ class VideoGenerator:
                  seed: int = 0,
                  backend: Optional[str] = None):
         self.cfg = mpi_config_from_dict(config)
+        validate_model_shapes(self.cfg)
         self.config = config
         self.chunk = chunk
         if backend is None:

@@ -23,7 +23,8 @@ import numpy as np
 import optax
 
 from mine_tpu import geometry
-from mine_tpu.config import MPIConfig, mpi_config_from_dict
+from mine_tpu.config import (MPIConfig, mpi_config_from_dict,
+                             validate_model_shapes)
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering, sampling
 from mine_tpu.parallel import mesh as mesh_lib
@@ -90,8 +91,7 @@ class SynthesisTrainer:
         self.cfg = mpi_config_from_dict(config)
         self.mesh = mesh
         self.steps_per_epoch = steps_per_epoch
-        # (img_h/img_w multiple-of-32 validation lives in
-        # mpi_config_from_dict — shared with the inference entry point)
+        validate_model_shapes(self.cfg)
 
         # Pallas backends compose with multi-device meshes via shard_map
         # (ops/rendering.py, ops/warp.py): warp splits B*S over data*plane,

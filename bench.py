@@ -89,11 +89,18 @@ VARIANT_TIMEOUT = float(os.environ.get("MINE_TPU_BENCH_VARIANT_TIMEOUT",
 # 16 GB HBM and the axon tunnel degrades into a crawl that then wedges the
 # server-side grant (measured 2026-07-31: xla_b8 0.55 img/s, xla_b8_remat
 # 0.30 img/s, then the next child's PJRT init timed out). B<=4 fits. RAW
-# (unchunked) b8 variants stay banned; xla_b8_chunk4 below re-enters B=8
+# (unchunked) b8 variants stay banned; b8_chunk4 below re-enters B=8
 # through plane-chunked decoding, which bounds the live activations to one
 # chunk.
 VARIANTS = {
-    "xla_b4": (4, {}),                      # 226.3 img/s measured on v5e
+    # shipped defaults (pallas warp+composite since the round-4 flip):
+    # THE headline row. Measured 7.989 img/s on v5e (2026-08-01).
+    "flagship_b4": (4, {}),
+    # the reference-style XLA gather/scatter warp, pinned explicitly now
+    # that defaults flipped: 0.595 img/s measured on v5e (the gather
+    # fusions are ~95% of the step — BENCH_NOTES_r04.md)
+    "xla_b4": (4, {"training.warp_backend": "xla",
+                   "training.composite_backend": "xla"}),
     "pallas_b4": (4, {"training.warp_backend": "pallas_diff",
                       "training.composite_backend": "pallas_diff"}),
     "xlabanded_b4": (4, {"training.warp_backend": "xla_banded"}),
@@ -102,25 +109,37 @@ VARIANTS = {
                            "training.warp_dtype": "bfloat16"}),
     "xlabanded_bf16_b4": (4, {"training.warp_backend": "xla_banded",
                               "training.warp_dtype": "bfloat16"}),
-    "xla_bf16warp_b4": (4, {"training.warp_dtype": "bfloat16"}),
-    "xla_b4_remat": (4, {"training.remat": "dots"}),
-    "xla_b2": (2, {}),
+    # NOTE round 4: variants below inherit the shipped "auto" backends
+    # (pallas on TPU). Names no longer carry an xla_ prefix — a prefixed
+    # name measuring the Pallas path would corrupt cross-round comparisons
+    # (pre-r4 JSON rows named xla_* measured the gather backend).
+    "bf16warp_b4": (4, {"training.warp_dtype": "bfloat16"}),
+    "remat_b4": (4, {"training.remat": "dots"}),
+    "flagship_b2": (2, {}),
     "pallas_b2": (2, {"training.warp_backend": "pallas_diff",
                       "training.composite_backend": "pallas_diff"}),
     # the reference's EXACT shipped LLFF config (512x384, B=2/device —
     # configs/params_llff.yaml) for the apples-to-apples row; the headline
     # stays at the 384x256 north-star shape (BASELINE.json)
-    "xla_b2_ref512": (2, {"data.img_h": 384, "data.img_w": 512}),
+    "ref512_b2": (2, {"data.img_h": 384, "data.img_w": 512}),
     # coarse-to-fine on device (round-2 VERDICT item 10): the fine path
     # (uniform coarse + pdf-sampled fine planes, mpi_rendering.py:244-271)
     # was CPU-tested only. 32+32 planes at B=2 keeps B*S=128 = the b4 load.
-    "xla_b2_c2f": (2, {"mpi.num_bins_fine": 32}),
+    "c2f_b2": (2, {"mpi.num_bins_fine": 32}),
+    # packed-head decoder (model.decoder_variant, models/decoder.py): the
+    # stride-2->1 stage computes at stride 2 with 4x channels + a
+    # depth-to-space head, lifting the reference architecture's worst MXU
+    # lane-occupancy stage (16/128 lanes -> 64/128; BENCH_NOTES_r03.md lane
+    # table). Parity note: exact phase-decomposition init from reference
+    # checkpoints exists (interior-exact); measured here to decide whether
+    # the past-the-ceiling lever is worth recommending.
+    "packed_b4": (4, {"model.decoder_variant": "packed"}),
     # B=8 re-entry via plane-chunked decoding (4 chunks of 8 planes, each
     # under remat -> backward holds one chunk's activations; models/mpi.py).
     # The raw b8 variants overflowed HBM and wedged the grant; this is the
     # designed fix. Kept LAST in sweep order: if it still thrashes, the
     # headline numbers are already on disk.
-    "xla_b8_chunk4": (8, {"training.decoder_plane_chunks": 4}),
+    "b8_chunk4": (8, {"training.decoder_plane_chunks": 4}),
 }
 
 
@@ -368,8 +387,12 @@ def main():
         return
 
     only = os.environ.get("MINE_TPU_BENCH_VARIANTS")
+    # default run = the flagship headline only: the full sweep is
+    # tools/tpu_window.sh's job; a cold compile costs ~9 min/variant
+    # through the tunnel, so "all variants" would burn a round-end bench
+    # (or a whole chip window) on compiles
     names = [n.strip() for n in only.split(",") if n.strip()] if only \
-        else list(VARIANTS)
+        else ["flagship_b4"]
     unknown = [n for n in names if n not in VARIANTS]
     if unknown or not names:
         print("unknown MINE_TPU_BENCH_VARIANTS %s (known: %s)"

@@ -105,13 +105,27 @@ class MPIConfig:
     # composite inside the loss graph (pallas_diff = fused Pallas forward +
     # custom-VJP backward; plane_scan = distributed plane-axis transparency
     # scan for plane-parallel meshes, ops/plane_scan.py)
+    # dataclass defaults are the NEUTRAL xla backends (safe on any
+    # platform); the shipped YAML default is "auto", resolved by
+    # mpi_config_from_dict to pallas_diff on TPU / xla elsewhere
     composite_backend: str = "xla"
     # "xla" | "xla_banded" | "pallas_diff": training-path homography warp
     # ("xla_banded" = banded one-hot-matmul in pure XLA, ops/warp_banded.py;
     # "pallas_diff" = banded MXU kernel fwd+bwd, kernels/warp_vjp.py; both
     # carry a runtime gather fallback for rotation-heavy poses)
     warp_backend: str = "xla"
-    warp_band: int = 32
+    warp_band: int = 48
+    # backward (gradient) band for the Pallas warp VJP. Measured need at
+    # bench poses (round-4 window, profiled per-scale): vertical
+    # COMPRESSION on the nearest plane makes one source row-block touched
+    # by far more target rows than the forward span, and the per-step
+    # scale factor (computed from network predictions, so wild at init —
+    # synthesis_task.py:211-220 semantics) multiplies the translation:
+    # at B=4 the batch-max scale-0 span exceeds 64 rows. 128 covers it
+    # with headroom; bwd MXU cost scales linearly with oband (≈19 ms/step
+    # measured for the kernel at oband=64 vs 4.5 s for the scale-0 gather
+    # fallback it replaces), fwd cost scales with warp_band.
+    warp_oband: int = 128
     # warp value dtype ("float32" | "bfloat16"): matmul operands in the
     # banded backends (bf16 doubles MXU rate) AND gather storage on the
     # default xla backend (bf16 halves the volume's HBM traffic); either
@@ -154,21 +168,32 @@ def validate_model_shapes(cfg: "MPIConfig") -> None:
                 f"{v // 32 * 32} or {-(-v // 32) * 32}")
 
 
+def _resolve_auto_backend(value: str) -> str:
+    """"auto" -> the measured-best backend for the RUNNING platform: the
+    Pallas custom-VJP pair on TPU (13.4x the gather path on v5e, round-4
+    measurement), plain XLA elsewhere (on CPU the Pallas kernels would run
+    in interpret mode — orders of magnitude slower than XLA)."""
+    if value != "auto":
+        return value
+    from mine_tpu.kernels import on_tpu_backend
+    return "pallas_diff" if on_tpu_backend() else "xla"
+
+
 def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
     g = config.get
     name = g("data.name", "llff")
-    backend = g("training.composite_backend", "xla")
+    backend = _resolve_auto_backend(g("training.composite_backend", "auto"))
     # "pallas" (forward-only) is an internal render-path backend; the training
     # loss graph differentiates through the composite, so only the custom-VJP
     # variant is valid here.
     if backend not in ("xla", "pallas_diff", "plane_scan"):
         raise ValueError(
-            f"training.composite_backend must be xla|pallas_diff|plane_scan, "
-            f"got {backend!r}")
-    warp_backend = g("training.warp_backend", "xla")
+            f"training.composite_backend must be auto|xla|pallas_diff|"
+            f"plane_scan, got {backend!r}")
+    warp_backend = _resolve_auto_backend(g("training.warp_backend", "auto"))
     if warp_backend not in ("xla", "xla_banded", "pallas_diff"):
         raise ValueError(
-            f"training.warp_backend must be xla|xla_banded|pallas_diff, "
+            f"training.warp_backend must be auto|xla|xla_banded|pallas_diff, "
             f"got {warp_backend!r}")
     warp_dtype = g("training.warp_dtype", "float32")
     if warp_dtype not in ("float32", "bfloat16"):
@@ -195,7 +220,8 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         use_multi_scale=g("training.use_multi_scale", True),
         composite_backend=backend,
         warp_backend=warp_backend,
-        warp_band=int(g("training.warp_band", 32)),
+        warp_band=int(g("training.warp_band", 48)),
+        warp_oband=int(g("training.warp_oband", 128)),
         warp_dtype=warp_dtype,
         # visible_point_count == 0 also disables the sparse-point terms —
         # datasets with no SfM points (public RealEstate10K) train scale-free

@@ -67,8 +67,11 @@ def path_planning(num_frames: int, x: float, y: float, z: float,
 
 
 # band height of the Pallas warp gather (kernels/warp.py); poses whose
-# row-block span exceeds it fall back to the XLA gather
-WARP_BAND = 16
+# row-block span (+ bilinear support + the kernel's sublane-alignment
+# slack) exceeds it fall back to the XLA gather. 32 (was 16): the round-4
+# alignment slack costs 7 rows of headroom, and forward-only banded cost
+# scales only linearly with the band.
+WARP_BAND = 32
 
 TRAJECTORY_PRESETS = {
     # dataset -> (fps, num_frames, x_ranges, y_ranges, z_ranges, types, names)
@@ -229,9 +232,14 @@ class VideoGenerator:
         warp_impl = "xla"
         if self.backend == "pallas" and self.cfg.img_h % 8 == 0:
             # banded Pallas gather only when the trajectory's warp fits the
-            # band (margin of 2 for the coarse span estimate)
+            # band: span + 2 rows of bilinear support + the kernel's
+            # sublane-alignment slack (kernels/warp.py _align_slack — the
+            # floored band start can sit up to 7 rows above the ideal one),
+            # + 2 extra margin for the coarse span estimate
+            from mine_tpu.kernels.warp import _align_slack
             span = self._max_row_block_span(poses_F44)
-            if span + 4 <= WARP_BAND:
+            slack = _align_slack(WARP_BAND, int(self.cfg.img_h))
+            if span + 4 + slack <= WARP_BAND:
                 warp_impl = "pallas"
         F = poses_F44.shape[0]
         rgbs, disps = [], []

@@ -45,8 +45,11 @@ def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
                  band_buf, sem):
     W_t = xc_ref.shape[2]
     # y0 comes in as the FULL [B', NB] table in SMEM (a (1,1) block would
-    # violate the Mosaic last-two-dims tiling rule); index it by grid step
-    y0 = y0_ref[pl.program_id(0), pl.program_id(1)]
+    # violate the Mosaic last-two-dims tiling rule); index it by grid step.
+    # band_start aligns it to the sublane tile; multiple_of carries that
+    # fact to Mosaic, which must PROVE dynamic HBM slice offsets aligned.
+    y0 = pl.multiple_of(y0_ref[pl.program_id(0), pl.program_id(1)],
+                        SUBLANE_ALIGN)
 
     # src arrives as the FULL array in HBM (ANY-space blocks must equal the
     # array shape); the batch index is applied here, the band via dynamic DMA
@@ -106,10 +109,29 @@ def pallas_bilinear_sample(src: jnp.ndarray,
     xc = jnp.clip(coords_x, 0.0, W_s - 1.0).astype(jnp.float32)
     yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
 
-    y0 = band_start(yc, H_s, band, RT)  # [B', NB]
+    # Mosaic constraints (hit on silicon, round-4 window): HBM slices of
+    # the (8,128)-tiled source must have 128-aligned lane width AND
+    # 8-aligned sublane offset/size. Pad the SOURCE (mosaic_band_geometry
+    # docstring): padded columns get exactly-zero tent weights (xc is
+    # clipped to the true W_s-1, so |xs - sx| >= 1 there), and padded rows
+    # likewise sit >= 1 row beyond the yc clip range — numerics unchanged.
+    band, pad_h, pad_w = mosaic_band_geometry(band, H_s, W_s)
+    if pad_h or pad_w:
+        src = jnp.pad(src, ((0, 0), (0, 0), (0, pad_h), (0, pad_w)))
+    H_pad, W_s = src.shape[2], src.shape[3]
+
+    y0 = band_start(yc, H_pad, band, RT)  # [B', NB]
+    # Sublane-align the dynamic DMA start (Mosaic must prove divisibility;
+    # see pl.multiple_of in the kernel). Floor only moves the start UP the
+    # image — ≤7 rows of headroom, accounted by fwd_domain_ok's slack —
+    # and the clip bound (H_pad - band) is itself aligned, so the bottom
+    # of the image stays covered. The XLA banded backend keeps the
+    # unaligned band_start (no Mosaic constraint); values agree wherever
+    # both bands cover, which the shared domain guard guarantees.
+    y0 = (y0 // SUBLANE_ALIGN) * SUBLANE_ALIGN
 
     grid = (Bp, NB)
-    kernel = functools.partial(_warp_kernel, C, band, RT, H_s, W_s,
+    kernel = functools.partial(_warp_kernel, C, band, RT, H_pad, W_s,
                                mxu_dtype)
 
     return pl.pallas_call(
@@ -122,7 +144,7 @@ def pallas_bilinear_sample(src: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, RT, W_t), lambda b, r: (b, r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((Bp, C, H_s, W_s), lambda b, r: (0, 0, 0, 0),
+            pl.BlockSpec((Bp, C, H_pad, W_s), lambda b, r: (0, 0, 0, 0),
                          memory_space=pl.ANY),  # stays in HBM; banded DMA
         ],
         out_specs=pl.BlockSpec((1, C, RT, W_t), lambda b, r: (b, 0, r, 0),
@@ -136,13 +158,55 @@ def pallas_bilinear_sample(src: jnp.ndarray,
     )(y0, xc, yc, src.astype(jnp.float32))
 
 
+# Dynamic HBM slice offsets must be provably divisible by the sublane tile
+# (8 for f32 (8,128)-tiled memrefs — all banded-warp DMA operands are cast
+# to f32). Hit on silicon at bench shapes (round-4 window): Mosaic rejects
+# an unaligned dynamic band start. Aligning the start DOWN costs at most
+# SUBLANE_ALIGN-1 rows of band headroom (accounted in the domain guards)
+# and is semantically free: band placement doesn't change values as long
+# as every needed source row stays in-band.
+SUBLANE_ALIGN = 8
+
+
+LANE_ALIGN = 128  # lane (last-dim) tile of f32/bf16 TPU memrefs
+
+
+def _align_slack(window: int, extent: int) -> int:
+    """Band-headroom rows consumed by sublane alignment (0 when the window
+    covers the whole extent — the start is then always 0, which is aligned)."""
+    return 0 if window >= extent else SUBLANE_ALIGN - 1
+
+
+def mosaic_band_geometry(band: int, extent: int, lane_extent: int):
+    """THE Mosaic alignment recipe, shared by the forward wrapper and the
+    VJP's backward wrapper so their domains can never desynchronize:
+
+      * ceil the band to the sublane tile (slice SIZE must be aligned),
+      * pad the banded (row) extent so the band-start clip bound
+        (extent_padded - band) is itself aligned — the clipped-start case
+        then stays covered, the band running into padding instead of
+        uncovering the last rows,
+      * pad the lane extent to the lane tile (slice WIDTH must be aligned).
+
+    Returns (band, pad_rows, pad_lanes).
+    """
+    band = -((-band) // SUBLANE_ALIGN) * SUBLANE_ALIGN
+    pad_rows = max((-extent) % SUBLANE_ALIGN, band - extent)
+    pad_lanes = (-lane_extent) % LANE_ALIGN
+    return band, pad_rows, pad_lanes
+
+
 def band_start(coords_y_clipped: jnp.ndarray, H_s: int, band: int,
                rows_per_block: int = 8) -> jnp.ndarray:
     """Band start row per (plane, row-block): floor of the block's min
     source row, clipped so the band stays inside the image. [B', NB] i32.
 
     THE band placement rule — shared by the Pallas forward kernel and the
-    pure-XLA banded warp so the two backends sample identical bands.
+    pure-XLA banded warp. The Pallas wrapper additionally sublane-aligns
+    the result (after padding H so the clip bound is itself aligned); the
+    XLA path needs no alignment. Both compute exact bilinear values inside
+    their band, so the backends agree wherever the shared domain guard
+    (fwd_domain_ok, which budgets the Pallas alignment slack) passes.
     """
     Bp, H_t, W_t = coords_y_clipped.shape
     NB = H_t // rows_per_block
@@ -156,20 +220,26 @@ def fwd_domain_ok(coords_y: jnp.ndarray, H_s: int, band: int,
     """Scalar bool (jit-safe): every row-block's source span fits the band.
 
     THE definition of the banded forward's correctness domain (span + 2
-    rows of bilinear support must fit the band, clamped to the image) —
-    shared by the Pallas VJP guard (kernels/warp_vjp.py) and the pure-XLA
-    banded warp (ops/warp_banded.py) so the two backends can never diverge
-    on which poses count as in-band. coords_y must be border-clipped.
+    rows of bilinear support + the sublane-alignment slack must fit the
+    band, clamped to the image) — shared by the Pallas VJP guard
+    (kernels/warp_vjp.py) and the pure-XLA banded warp (ops/warp_banded.py)
+    so the two backends can never diverge on which poses count as in-band.
+    coords_y must be border-clipped.
     """
-    return band_span(coords_y, H_s, rows_per_block) + 2.0 <= min(band, H_s)
+    eff = min(band, H_s)
+    return band_span(coords_y, H_s, rows_per_block) + 2.0 \
+        <= eff - _align_slack(eff, H_s)
 
 
 def band_span(coords_y: jnp.ndarray, H_s: int,
               rows_per_block: int = 8) -> jnp.ndarray:
-    """Max per-row-block source-row span (rows needed = span + 2).
+    """Max per-row-block source-row span (rows needed = span + 2, plus the
+    sublane-alignment slack when the Pallas kernel is the target).
 
-    Callers check `band_span(...) + 2 <= band` before choosing the kernel;
-    with host-known poses this is a cheap numpy decision per chunk.
+    Callers check `band_span(...) + 2 + _align_slack(band, H_s) <= band`
+    before choosing the kernel (fwd_domain_ok is the jit-safe form; the
+    video renderer applies the same rule to its numpy span estimate); with
+    host-known poses this is a cheap numpy decision per chunk.
     """
     Bp, H_t, W_t = coords_y.shape
     NB = H_t // rows_per_block

@@ -45,7 +45,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mine_tpu.kernels.warp import fwd_domain_ok, pallas_bilinear_sample
+from mine_tpu.kernels.warp import (SUBLANE_ALIGN, _align_slack,
+                                   fwd_domain_ok, mosaic_band_geometry,
+                                   pallas_bilinear_sample)
 
 
 def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
@@ -57,8 +59,9 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
     b = pl.program_id(0)
     sb = pl.program_id(1)
     # full [B', NBs] table in SMEM (a (1,1) block would violate the Mosaic
-    # last-two-dims tiling rule); index it by grid step
-    o0 = o0_ref[b, sb]
+    # last-two-dims tiling rule); index it by grid step. _warp_bwd aligns
+    # it to the sublane tile; multiple_of carries the proof to Mosaic.
+    o0 = pl.multiple_of(o0_ref[b, sb], SUBLANE_ALIGN)
     h0 = (sb * RS).astype(jnp.float32)
 
     # g/xc/yc arrive as FULL arrays in HBM (ANY-space blocks must equal the
@@ -79,16 +82,33 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
     hs = jax.lax.broadcasted_iota(jnp.int32, (RS, W_t), 0).astype(
         jnp.float32) + h0
 
-    accum = jnp.zeros((C * RS, W_s), jnp.float32)
-    for ob in range(OBAND):
-        sx = xc_buf[ob:ob + 1, :]                       # [1, W_t]
-        sy = yc_buf[ob:ob + 1, :]                       # [1, W_t]
+    # fori_loop over UNROLL-sized chunks instead of a full Python unroll:
+    # at oband=128 the fully-unrolled body's live intermediates overflow
+    # the 16M VMEM stack (hit on silicon, round-4 window); the loop bounds
+    # the live set while the unrolled inner block keeps the MXU fed.
+    UNROLL = 8
+    n_chunks = OBAND // UNROLL
+
+    def splat_one(ob, accum):
+        sx = xc_buf[pl.ds(ob, 1), :]                    # [1, W_t]
+        sy = yc_buf[pl.ds(ob, 1), :]                    # [1, W_t]
         wy = jnp.maximum(1.0 - jnp.abs(hs - sy), 0.0)   # [RS, W_t]
-        m = g_buf[:, ob, :][:, None, :] * wy[None]      # [C, RS, W_t]
+        m = g_buf[:, pl.ds(ob, 1), :] * wy[None]        # [C, RS, W_t]
         wxT = jnp.maximum(1.0 - jnp.abs(ws - sx.T), 0.0)  # [W_t, W_s]
-        accum = accum + jnp.dot(
+        return accum + jnp.dot(
             m.reshape(C * RS, W_t).astype(mxu_dtype),
             wxT.astype(mxu_dtype), preferred_element_type=jnp.float32)
+
+    def chunk(i, accum):
+        base = i * UNROLL
+        for k in range(UNROLL):
+            accum = splat_one(base + k, accum)
+        return accum
+
+    accum = jax.lax.fori_loop(
+        0, n_chunks, chunk, jnp.zeros((C * RS, W_s), jnp.float32))
+    for ob in range(n_chunks * UNROLL, OBAND):  # static remainder
+        accum = splat_one(ob, accum)
     out_ref[0] = accum.reshape(C, RS, W_s)
 
 
@@ -132,9 +152,26 @@ def _warp_bwd(g, coords_x, coords_y, src_shape,
     xc, yc = _clip_coords(src_shape, coords_x, coords_y)
     first, _, any_touch = _touch_bounds(yc, H_s, RS)
     o0 = jnp.where(any_touch, first, 0)
-    o0 = jnp.clip(o0, 0, max(H_t - oband, 0)).astype(jnp.int32)  # [Bp, NBs]
 
-    kernel = functools.partial(_bwd_kernel, C, oband, RS, H_t, W_t,
+    # Mosaic constraints (hit on silicon, round-4 window): the three band
+    # DMAs slice HBM memrefs that need a 128-aligned lane width AND an
+    # 8-aligned sublane (gradient-row) offset/size. Shared recipe
+    # (kernels/warp.py mosaic_band_geometry); padding is sound here
+    # because the splat is linear in g and every padded g value is zero,
+    # so padded columns'/rows' (arbitrary-coordinate) contributions vanish.
+    oband, pad_h, pad_w = mosaic_band_geometry(oband, H_t, W_t)
+    if pad_h or pad_w:
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, pad_h), (0, pad_w)))
+        xc = jnp.pad(xc, ((0, 0), (0, pad_h), (0, pad_w)))
+        yc = jnp.pad(yc, ((0, 0), (0, pad_h), (0, pad_w)))
+    H_t_pad, W_t = xc.shape[1], xc.shape[2]
+
+    o0 = jnp.clip(o0, 0, max(H_t_pad - oband, 0)).astype(jnp.int32)
+    # sublane-align the dynamic gradient-band start (floor keeps it in
+    # range; the headroom cost is accounted in diff_domain_ok)
+    o0 = (o0 // SUBLANE_ALIGN) * SUBLANE_ALIGN  # [Bp, NBs]
+
+    kernel = functools.partial(_bwd_kernel, C, oband, RS, H_t_pad, W_t,
                                mxu_dtype)
     return pl.pallas_call(
         kernel,
@@ -142,11 +179,11 @@ def _warp_bwd(g, coords_x, coords_y, src_shape,
         in_specs=[
             pl.BlockSpec((Bp, NBs), lambda b, s: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((Bp, C, H_t, W_t), lambda b, s: (0, 0, 0, 0),
+            pl.BlockSpec((Bp, C, H_t_pad, W_t), lambda b, s: (0, 0, 0, 0),
                          memory_space=pl.ANY),   # gradient stays in HBM
-            pl.BlockSpec((Bp, H_t, W_t), lambda b, s: (0, 0, 0),
+            pl.BlockSpec((Bp, H_t_pad, W_t), lambda b, s: (0, 0, 0),
                          memory_space=pl.ANY),
-            pl.BlockSpec((Bp, H_t, W_t), lambda b, s: (0, 0, 0),
+            pl.BlockSpec((Bp, H_t_pad, W_t), lambda b, s: (0, 0, 0),
                          memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, C, RS, W_s), lambda b, s: (b, 0, s, 0),
@@ -214,7 +251,9 @@ def diff_domain_ok(src_shape, coords_y, band: int, oband: int,
 
     first, last, any_touch = _touch_bounds(yc, H_s, rows_per_block)
     span = jnp.where(any_touch, last - first + 1, 0)
-    bwd_ok = jnp.max(span) <= min(oband, coords_y.shape[1])
+    H_t = coords_y.shape[1]
+    eff = min(oband, H_t)
+    bwd_ok = jnp.max(span) <= eff - _align_slack(eff, H_t)
     return jnp.logical_and(fwd_ok, bwd_ok)
 
 

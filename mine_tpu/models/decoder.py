@@ -31,6 +31,17 @@ from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS, constrain
 NUM_CH_DEC = (16, 32, 64, 128, 256)
 
 
+def depth_to_space_2x(x):
+    """[N, h, w, 4*C] -> [N, 2h, 2w, C]; phase layout (dy, dx, c) so phase
+    groups are contiguous blocks of C channels (the layout the packed-head
+    weight transform in tools/convert_torch_weights.py emits)."""
+    N, h, w, C4 = x.shape
+    C = C4 // 4
+    x = x.reshape(N, h, w, 2, 2, C)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))  # N, h, dy, w, dx, C
+    return x.reshape(N, 2 * h, 2 * w, C)
+
+
 class MPIDecoder(nn.Module):
     num_ch_enc: Tuple[int, ...]  # encoder channels, e.g. (64,256,512,1024,2048)
     pos_encoding_multires: int = 10
@@ -39,6 +50,20 @@ class MPIDecoder(nn.Module):
     num_output_channels: int = 4
     use_skips: bool = True
     sigma_dropout_rate: float = 0.0
+    # "reference": the monodepth2 geometry exactly (checkpoint-parity
+    #   default).
+    # "packed": the stride-2->1 stage (upconv_0_* + dispconv_0 — the
+    #   largest-pixel-count convs, capped at 16/128 MXU lanes by the
+    #   reference's tiny channel counts; BENCH_NOTES_r03.md lane table)
+    #   computes at stride 2 with 4x channels and a depth-to-space at the
+    #   head, lifting that stage to 64-lane occupancy. Conversion story: a
+    #   nearest-upsample followed by a 3x3 conv is exactly a 4-phase conv
+    #   at the low resolution (each output phase (dy,dx) sees a fixed
+    #   subset of taps collapsed onto the half-res grid), so reference
+    #   upconv_0_0/upconv_0_1/dispconv_0 weights map EXACTLY onto the
+    #   packed kernels (phase-replicated BN params; interior-exact —
+    #   reflect padding at stride 2 differs from stride 1 in a 2px border).
+    variant: str = "reference"
     dtype: Optional[jnp.dtype] = None
     # jax.sharding.Mesh (hashable): when set, the B*S decoder batch is
     # constrained to shard over ("data","plane") so GSPMD distributes the
@@ -112,17 +137,25 @@ class MPIDecoder(nn.Module):
 
         outputs = {}
         for i in range(4, -1, -1):
-            x = ConvBlock(NUM_CH_DEC[i], dtype=self.dtype,
-                          name=f"upconv_{i}_0")(x, train)
-            x = shard_bs(upsample_nearest_2x(x))
+            packed = self.variant == "packed" and i == 0
+            width = NUM_CH_DEC[i] * (4 if packed else 1)
+            x = ConvBlock(width, dtype=self.dtype,
+                          name=f"upconv_{i}_0{'p' if packed else ''}")(
+                              x, train)
+            if not packed:  # packed stage 0 stays at stride 2 until its head
+                x = shard_bs(upsample_nearest_2x(x))
             if self.use_skips and i > 0:
                 x = jnp.concatenate(
                     [x, expand_cat(features[i - 1].astype(dd))], axis=-1)
-            x = ConvBlock(NUM_CH_DEC[i], dtype=self.dtype,
-                          name=f"upconv_{i}_1")(x, train)
+            x = ConvBlock(width, dtype=self.dtype,
+                          name=f"upconv_{i}_1{'p' if packed else ''}")(
+                              x, train)
             if i in self.scales:
-                out = Conv(self.num_output_channels, 3, pad_mode="reflect",
-                           dtype=self.dtype, name=f"dispconv_{i}")(x)
+                out = Conv(self.num_output_channels * (4 if packed else 1),
+                           3, pad_mode="reflect", dtype=self.dtype,
+                           name=f"dispconv_{i}{'p' if packed else ''}")(x)
+                if packed:
+                    out = depth_to_space_2x(out)
                 out = out.astype(jnp.float32)  # rendering happens in fp32
                 rgb = nn.sigmoid(out[..., 0:3])
                 if self.use_alpha:

@@ -44,8 +44,17 @@ class MPIPredictor(nn.Module):
     dtype: Optional[jnp.dtype] = None
     mesh: Optional[Any] = None  # forwarded to the decoder's B*S sharding
     plane_chunks: int = 1  # decoder calls over the S axis (memory knob)
+    decoder_variant: str = "reference"  # "packed": stride-2 output stage
+    # with 4x channels + depth-to-space head (models/decoder.py variant doc)
 
     def setup(self):
+        if self.decoder_variant not in ("reference", "packed"):
+            # fail at construction: a typo ("packed_head", "Packed") would
+            # otherwise silently build the reference geometry and train the
+            # wrong architecture under the right name
+            raise ValueError(
+                f"model.decoder_variant must be 'reference' or 'packed', "
+                f"got {self.decoder_variant!r}")
         self.backbone = ResnetEncoder(num_layers=self.num_layers,
                                       dtype=self.dtype, name="backbone")
         decoder_cls = MPIDecoder
@@ -60,6 +69,7 @@ class MPIPredictor(nn.Module):
             use_alpha=self.use_alpha,
             scales=tuple(self.scales),
             sigma_dropout_rate=self.sigma_dropout_rate,
+            variant=self.decoder_variant,
             dtype=self.dtype,
             mesh=self.mesh,
             name="decoder")

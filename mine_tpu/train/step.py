@@ -106,7 +106,9 @@ class SynthesisTrainer:
             sigma_dropout_rate=self.cfg.sigma_dropout_rate,
             dtype=dtype,
             mesh=mesh if (mesh is not None and mesh.size > 1) else None,
-            plane_chunks=int(config.get("training.decoder_plane_chunks", 1)))
+            plane_chunks=int(config.get("training.decoder_plane_chunks", 1)),
+            decoder_variant=str(config.get("model.decoder_variant",
+                                           "reference")))
         chunks = self.model.plane_chunks
         if chunks > 1:
             # fail at construction, not as a silent unchunked (full-B*S HBM)

@@ -44,6 +44,35 @@ def fake_resnet18_sd(prefix=""):
     return sd
 
 
+def fake_resnet50_sd(prefix=""):
+    """State dict with torchvision resnet50 key layout + real shapes
+    (Bottleneck: conv1 1x1 / conv2 3x3 / conv3 1x1, expansion 4; every
+    layer's block 0 has a downsample, including layer1 where 64 -> 256)."""
+    sd = {}
+    sd[prefix + "conv1.weight"] = _t(64, 3, 7, 7)
+    for k in ("weight", "bias", "running_mean", "running_var"):
+        sd[prefix + f"bn1.{k}"] = _t(64)
+    blocks = [3, 4, 6, 3]
+    widths = [64, 128, 256, 512]
+    cin = 64
+    for layer, (nb, w) in enumerate(zip(blocks, widths), start=1):
+        for b in range(nb):
+            base = prefix + f"layer{layer}.{b}"
+            c_in = cin if b == 0 else w * 4
+            sd[f"{base}.conv1.weight"] = _t(w, c_in, 1, 1)
+            sd[f"{base}.conv2.weight"] = _t(w, w, 3, 3)
+            sd[f"{base}.conv3.weight"] = _t(w * 4, w, 1, 1)
+            for n, c in ((1, w), (2, w), (3, w * 4)):
+                for k in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{base}.bn{n}.{k}"] = _t(c)
+            if b == 0:
+                sd[f"{base}.downsample.0.weight"] = _t(w * 4, c_in, 1, 1)
+                for k in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{base}.downsample.1.{k}"] = _t(w * 4)
+        cin = w * 4
+    return sd
+
+
 def fake_mine_decoder_sd(num_ch_enc=(64, 64, 128, 256, 512), E=21):
     """State dict with the reference DepthDecoder layout (depth_decoder.py)."""
     sd = {}

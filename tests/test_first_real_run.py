@@ -1,0 +1,52 @@
+"""tools/first_real_run.sh — the one-command real-data driver (round-3
+VERDICT item 6) — must run END TO END today via its --fixture mode:
+generated COLMAP scene -> real llff loader -> train_cli (2 tiny epochs) ->
+eval_cli -> artifacts. Preflight failures must be early and instructive."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tools", "first_real_run.sh")
+
+
+def _run(args, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(["sh", SCRIPT] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, **kw)
+
+
+def test_preflight_missing_dataset_fails_fast_with_instructions(tmp_path):
+    r = _run(["--data", str(tmp_path / "nope")])
+    assert r.returncode == 1
+    assert "does not exist" in r.stderr
+    assert "sparse/0" in r.stderr  # tells the user the expected layout
+
+
+def test_preflight_missing_checkpoint_names_the_grid(tmp_path):
+    (tmp_path / "s0" / "sparse" / "0").mkdir(parents=True)
+    (tmp_path / "s0" / "images").mkdir()
+    r = _run(["--data", str(tmp_path), "--checkpoint",
+              str(tmp_path / "missing.pth")])
+    assert r.returncode == 1
+    assert "README.md:43-50" in r.stderr  # points at the released grid
+
+
+@pytest.mark.slow
+def test_fixture_mode_end_to_end(tmp_path):
+    ws = str(tmp_path / "ws")
+    r = _run(["--fixture", ws], timeout=1800)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    # every stage left its artifact
+    assert os.path.isdir(os.path.join(ws, "data_root", "scene0", "sparse"))
+    assert os.path.isfile(os.path.join(ws, "run", "v1", "params.yaml"))
+    assert os.path.exists(os.path.join(ws, "run", "v1", "checkpoint_latest"))
+    with open(os.path.join(ws, "eval_ours.json")) as f:
+        metrics = json.loads(f.read().strip().splitlines()[-1])
+    assert np.isfinite(metrics["psnr_tgt"])
+    assert "done" in r.stdout

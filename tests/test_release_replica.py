@@ -1,0 +1,196 @@
+"""Released-checkpoint dry run (VERDICT r3 item 4): prove the FIRST real
+MINE release .pth will convert and evaluate without hand-holding.
+
+Zero egress means the released weights cannot exist in this container, so
+these tests synthesize a byte-accurate replica of the release structure
+instead (synthesis_task.py:629-631 save format):
+
+  {"backbone": {<DDP 'module.' + 'encoder.'-nested torchvision resnet50 sd,
+                 incl. num_batches_tracked int64 buffers>},
+   "decoder":  {<DDP 'module.' + reference DepthDecoder sd (the char-joined
+                 ModuleDict keys, depth_decoder.py:36-38), incl.
+                 num_batches_tracked>},
+   "optimizer": <two-param-group Adam state dict: per-param step/exp_avg/
+                 exp_avg_sq keyed by global param index,
+                 synthesis_task.py:83-87>}
+
+and gate the full convert -> eval_cli -> parity_eval chain on it, at BOTH
+released plane counts (N=32-style and N=64, README.md:43-50 grid).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from convert_torch_weights import main as convert_main  # noqa: E402
+
+from tests.test_convert import (fake_mine_decoder_sd,  # noqa: E402
+                                fake_resnet50_sd)
+
+
+def _torchify(sd):
+    """numpy fakes -> torch tensors, tamed so eval renders stay sane (BN
+    scale near 1, small means/kernels — same policy as test_eval_cli)."""
+    import torch
+
+    out = {}
+    for k, v in sd.items():
+        if k.endswith("running_var"):
+            v = np.abs(v) * 0.1 + 1.0
+        elif k.endswith("running_mean"):
+            v = v * 0.1
+        elif (k.endswith((".bn.weight", "bn1.weight", "bn2.weight",
+                          "bn3.weight")) or ".1.weight" in k
+                or "downsample.1.weight" in k):
+            v = 1.0 + 0.1 * v
+        elif k.endswith("bias"):
+            v = v * 0.1
+        else:
+            v = v * 0.2
+        out[k] = torch.from_numpy(
+            np.ascontiguousarray(np.asarray(v, np.float32)))
+    return out
+
+
+def _add_num_batches_tracked(sd):
+    """Every BN in a real torch state dict carries an int64 scalar
+    'num_batches_tracked' buffer next to its running stats."""
+    import torch
+
+    for k in [k for k in sd if k.endswith("running_mean")]:
+        sd[k.replace("running_mean", "num_batches_tracked")] = \
+            torch.tensor(123456, dtype=torch.int64)
+    return sd
+
+
+def _adam_state(param_sds, lrs, weight_decay=0.0):
+    """Two-group Adam state dict exactly as torch serializes it: state keyed
+    by GLOBAL param index over the concatenated param groups
+    (synthesis_task.py:83-87 — [{backbone, lr.backbone_lr},
+    {decoder, lr.decoder_lr}])."""
+    import torch
+
+    state, groups, idx = {}, [], 0
+    for sd, lr in zip(param_sds, lrs):
+        # optimizer params = learnable tensors only (float, not buffers)
+        keys = [k for k in sd
+                if not k.endswith(("running_mean", "running_var",
+                                   "num_batches_tracked"))]
+        ids = list(range(idx, idx + len(keys)))
+        for i, k in zip(ids, keys):
+            state[i] = {
+                "step": torch.tensor(200000, dtype=torch.int64),
+                "exp_avg": torch.zeros_like(sd[k]),
+                "exp_avg_sq": torch.zeros_like(sd[k]),
+            }
+        groups.append({"lr": lr, "betas": (0.9, 0.999), "eps": 1e-8,
+                       "weight_decay": weight_decay, "amsgrad": False,
+                       "params": ids})
+        idx += len(keys)
+    return {"state": state, "param_groups": groups}
+
+
+def release_replica_checkpoint(path):
+    """torch.save a full released-format resnet50 MINE checkpoint replica."""
+    import torch
+
+    backbone = _add_num_batches_tracked(_torchify(fake_resnet50_sd()))
+    decoder = _add_num_batches_tracked(_torchify(fake_mine_decoder_sd(
+        num_ch_enc=(64, 256, 512, 1024, 2048))))
+    ckpt = {
+        "backbone": {("module.encoder." + k): v for k, v in backbone.items()},
+        "decoder": {("module." + k): v for k, v in decoder.items()},
+        "optimizer": _adam_state([backbone, decoder], [1e-4, 2e-4],
+                                 weight_decay=0.0),
+    }
+    torch.save(ckpt, path)
+
+
+def test_convert_resnet50_release_covers_full_model(tmp_path):
+    """The replica .pth converts through the CLI path and the result covers
+    the flagship MPIPredictor(50) param + batch-stats space EXACTLY — no
+    missing keys (a real checkpoint would fail to restore) and no unknown
+    keys (weights silently dropped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.models.mpi import MPIPredictor
+
+    pth = str(tmp_path / "mine_release_replica.pth")
+    npz = str(tmp_path / "converted.npz")
+    release_replica_checkpoint(pth)
+    convert_main(["mine", "--src", pth, "--out", npz])
+
+    out = dict(np.load(npz))
+    model = MPIPredictor(num_layers=50)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                           jnp.full((1, 2), 0.5), train=False)
+
+    def flatten(prefix, tree, into):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                flatten(key, v, into)
+            else:
+                into[key] = v
+
+    want_params, want_stats = {}, {}
+    flatten("", variables["params"], want_params)
+    flatten("", variables["batch_stats"], want_stats)
+    got_params = {k: v for k, v in out.items() if not k.startswith("stats:")}
+    got_stats = {k[len("stats:"):]: v for k, v in out.items()
+                 if k.startswith("stats:")}
+
+    assert set(got_params) == set(want_params), \
+        sorted(set(got_params) ^ set(want_params))[:10]
+    assert set(got_stats) == set(want_stats), \
+        sorted(set(got_stats) ^ set(want_stats))[:10]
+    for k in want_params:
+        assert got_params[k].shape == tuple(want_params[k].shape), k
+
+
+@pytest.mark.slow
+def test_release_replica_parity_eval_n32_and_n64(tmp_path, monkeypatch):
+    """parity_eval runs the resnet50 replica end-to-end at both released
+    plane counts. S is irrelevant to the weight structure (disparity is an
+    encoded scalar input), so the N=64 leg proves the CONFIG path — S=64
+    sampling + the B*S=64 decoder batch — against the same converted file."""
+    from parity_eval import main as parity_main
+
+    pth = str(tmp_path / "mine_release_replica.pth")
+    release_replica_checkpoint(pth)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    base = {
+        "data.img_h": 64, "data.img_w": 64,
+        "data.num_seq_per_gpu": 1,
+        "data.per_gpu_batch_size": 1,
+        "data.visible_point_count": 16,
+        "mpi.disparity_start": 1.0, "mpi.disparity_end": 0.2,
+        "model.num_layers": 50,
+        "training.dtype": "float32",
+    }
+    results = {}
+    for n_bins in (4, 64):  # 4 = cheap stand-in for the N=32 leg's protocol
+        r = parity_main([
+            "--reference_checkpoint", pth,
+            "--dataset", "synthetic",
+            "--workdir", str(tmp_path / f"work{n_bins}"),
+            "--extra_config",
+            json.dumps({**base, "mpi.num_bins_coarse": n_bins}),
+        ])
+        assert np.isfinite(r["psnr_tgt"]), (n_bins, r)
+        assert np.isfinite(r["loss_ssim_tgt"]), (n_bins, r)
+        assert r["missing_metrics"] == ["lpips_tgt"]
+        results[n_bins] = r
+    # same weights, eval mode: metrics must be finite at both plane counts
+    # and the converted artifact is shared (converted once per leg, equal)
+    a = dict(np.load(tmp_path / "work4" / "reference_converted.npz"))
+    b = dict(np.load(tmp_path / "work64" / "reference_converted.npz"))
+    assert set(a) == set(b)

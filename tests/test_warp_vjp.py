@@ -39,7 +39,7 @@ def test_forward_matches_gather():
     src = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
     x, y = _mild_coords(rng, Bp, H, W)
     ref = warp.bilinear_sample(src, x, y)
-    out = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret())
+    out = bilinear_sample_diff(src, x, y, 24, 24, 8, kernel_test_utils.interpret())
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 
@@ -56,7 +56,7 @@ def test_grad_matches_gather_path():
         return jnp.sum(warp.bilinear_sample(s, x, y) * cot)
 
     def loss_ker(s):
-        return jnp.sum(bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.interpret()) * cot)
+        return jnp.sum(bilinear_sample_diff(s, x, y, 24, 24, 8, kernel_test_utils.interpret()) * cot)
 
     g_ref = jax.grad(loss_ref)(src)
     g_ker = jax.grad(loss_ker)(src)
@@ -77,19 +77,22 @@ def test_grad_with_border_clamping():
 
     g_ref = jax.grad(lambda s: jnp.sum(warp.bilinear_sample(s, x, y) * cot))(src)
     g_ker = jax.grad(lambda s: jnp.sum(
-        bilinear_sample_diff(s, x, y, 16, 16, 8, kernel_test_utils.interpret()) * cot))(src)
+        bilinear_sample_diff(s, x, y, 24, 24, 8, kernel_test_utils.interpret()) * cot))(src)
     np.testing.assert_allclose(np.asarray(g_ker), np.asarray(g_ref),
                                rtol=1e-4, atol=1e-4)
 
 
 def test_domain_check_classifies():
+    """Mild coords pass, rotation-heavy fail. Bands of 24 (not 16): the
+    guard budgets SUBLANE_ALIGN-1 rows of slack for the Mosaic-mandated
+    aligned band starts (kernels/warp.py, round-4 silicon constraint)."""
     rng = np.random.RandomState(3)
     Bp, C, H, W = 2, 3, 32, 48
     shape = (Bp, C, H, W)
     _, y_ok = _mild_coords(rng, Bp, H, W)
     _, y_bad = _rotation_heavy_coords(rng, Bp, H, W)
-    assert bool(diff_domain_ok(shape, y_ok, 16, 16, 8))
-    assert not bool(diff_domain_ok(shape, y_bad, 16, 16, 8))
+    assert bool(diff_domain_ok(shape, y_ok, 24, 24, 8))
+    assert not bool(diff_domain_ok(shape, y_bad, 24, 24, 8))
 
 
 def test_guarded_fallback_is_exact():
@@ -148,15 +151,15 @@ def test_bf16_mxu_variant_close_to_f32():
     x, y = _mild_coords(rng, Bp, H, W)
     cot = jnp.asarray(rng.normal(size=(Bp, C, H, W)).astype(np.float32))
 
-    out32 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.float32)
-    out16 = bilinear_sample_diff(src, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.bfloat16)
+    out32 = bilinear_sample_diff(src, x, y, 24, 24, 8, kernel_test_utils.interpret(), jnp.float32)
+    out16 = bilinear_sample_diff(src, x, y, 24, 24, 8, kernel_test_utils.interpret(), jnp.bfloat16)
     np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
                                rtol=0.05, atol=0.03)
 
     g32 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
-        s, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.float32) * cot))(src)
+        s, x, y, 24, 24, 8, kernel_test_utils.interpret(), jnp.float32) * cot))(src)
     g16 = jax.grad(lambda s: jnp.sum(bilinear_sample_diff(
-        s, x, y, 16, 16, 8, kernel_test_utils.interpret(), jnp.bfloat16) * cot))(src)
+        s, x, y, 24, 24, 8, kernel_test_utils.interpret(), jnp.bfloat16) * cot))(src)
     np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
                                rtol=0.05, atol=0.05)
 
@@ -170,5 +173,5 @@ def test_coord_cotangents_are_zero():
     x, y = _mild_coords(rng, Bp, H, W)
 
     gx = jax.grad(lambda xx: jnp.sum(
-        bilinear_sample_diff(src, xx, y, 16, 16, 8, kernel_test_utils.interpret())))(x)
+        bilinear_sample_diff(src, xx, y, 24, 24, 8, kernel_test_utils.interpret())))(x)
     assert float(jnp.max(jnp.abs(gx))) == 0.0

@@ -148,6 +148,73 @@ def convert_mine_checkpoint(ckpt):
     return out
 
 
+# ---------------- packed-head decoder variant ----------------
+
+_PHASES = ((0, 0), (0, 1), (1, 0), (1, 1))  # (dy, dx), phase-major channels
+
+
+def _phase_taps(d):
+    """Stage-0 stride-1 conv tap u (kernel index 0..2) applied at output
+    phase offset d of a nearest-2x-upsampled map: the stride-1 coordinate
+    a = 2i + d + (u-1) lands on low-res cell i + (a//2 - i) and carries
+    residual phase a % 2. Returns [(low-res kernel index 0..2, phase)]."""
+    return [((d + u - 1) // 2 + 1, (d + u - 1) % 2) for u in range(3)]
+
+
+def _pack_conv_on_upsampled(W):
+    """3x3 kernel [3,3,Cin,Cout] consumed at stride 1 on a nearest-2x
+    upsample -> equivalent 3x3 kernel [3,3,4Cin,4Cout] on the packed
+    (phase-major depth-to-space) stride-2 representation. Exact in the
+    interior: each output phase's taps collapse onto low-res cells."""
+    kh, kw, Cin, Cout = W.shape
+    assert (kh, kw) == (3, 3), W.shape
+    Wp = np.zeros((3, 3, 4 * Cin, 4 * Cout), W.dtype)
+    for oph, (dy, dx) in enumerate(_PHASES):
+        for u, (r, py) in enumerate(_phase_taps(dy)):
+            for v, (s, px) in enumerate(_phase_taps(dx)):
+                iph = py * 2 + px
+                Wp[r, s, iph * Cin:(iph + 1) * Cin,
+                   oph * Cout:(oph + 1) * Cout] += W[u, v]
+    return Wp
+
+
+def packed_head_transform(flat):
+    """Reference stage-0 decoder weights -> the packed-head variant
+    (model.decoder_variant: "packed", models/decoder.py).
+
+    Function-preserving (eval mode, image interior; reflect padding at
+    stride 2 differs from stride 1 in a <=2px border):
+      * upconv_0_0p = upconv_0_0 with outputs replicated across the 4
+        phases (nearest upsample == phase replication),
+      * upconv_0_1p / dispconv_0p = the stride-1 convs with the upsample
+        folded in via phase decomposition (_pack_conv_on_upsampled),
+      * BN params/stats replicated per phase (per-channel ops commute
+        with the packing).
+    """
+    out = dict(flat)
+
+    def move(src, dst, fn):
+        for fmt in ("{}", "stats:{}"):
+            for key in [k for k in list(out)
+                        if k.startswith(fmt.format(src + "/"))]:
+                out[key.replace(src + "/", dst + "/", 1)] = fn(out.pop(key))
+
+    def tile_ch(a):
+        """Replicate channel-indexed arrays phase-major; kernels tile the
+        OUTPUT channel axis (nearest upsample of the conv's result)."""
+        return np.tile(a, (1, 1, 1, 4)) if a.ndim == 4 else np.tile(a, 4)
+
+    def pack(a):
+        if a.ndim == 4:
+            return _pack_conv_on_upsampled(a)
+        return np.tile(a, 4)  # bias / BN vectors: replicate per out phase
+
+    move("decoder/upconv_0_0", "decoder/upconv_0_0p", tile_ch)
+    move("decoder/upconv_0_1", "decoder/upconv_0_1p", pack)
+    move("decoder/dispconv_0", "decoder/dispconv_0p", pack)
+    return out
+
+
 # ---------------- LPIPS ----------------
 
 _VGG_FEATURE_IDXS = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28]
@@ -181,6 +248,9 @@ def main(argv=None):
     p = sub.add_parser("mine")
     p.add_argument("--src", required=True)
     p.add_argument("--out", required=True)
+    p.add_argument("--packed_head", action="store_true",
+                   help="emit weights for model.decoder_variant=packed "
+                        "(exact phase-decomposition of the stage-0 convs)")
     p = sub.add_parser("lpips")
     p.add_argument("--vgg", required=True)
     p.add_argument("--lin", required=True)
@@ -191,6 +261,8 @@ def main(argv=None):
         out = convert_resnet_sd(_strip_module(_load_torch(args.src)))
     elif args.cmd == "mine":
         out = convert_mine_checkpoint(_load_torch(args.src))
+        if args.packed_head:
+            out = packed_head_transform(out)
     else:
         out = convert_lpips(_load_torch(args.vgg), _load_torch(args.lin))
     np.savez(args.out, **out)

@@ -27,8 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # variants whose lowering differs structurally from the already-proven
 # xla_b4 (pallas kernels at bench shapes, bf16 warp, plane-chunked b8,
 # coarse-to-fine); plain-XLA b2/b4 rows lower identically modulo shapes
-DEFAULT_VARIANTS = ("pallas_b4", "pallas_bf16_b4", "xla_b8_chunk4",
-                    "xla_b2_c2f")
+DEFAULT_VARIANTS = ("pallas_b4", "pallas_bf16_b4", "b8_chunk4",
+                    "c2f_b2", "packed_b4")
 
 
 def main(argv=None):

@@ -54,7 +54,7 @@ def _stage_body(stage: str) -> None:
     elif stage == "step50":
         import bench
         # byte-identical to the benchmark's xla_b2 variant — shared builder
-        trainer, state, batch = bench.build_variant_program("xla_b2")
+        trainer, state, batch = bench.build_variant_program("flagship_b2")
         state, metrics = trainer.train_step(state, batch)
         jax.block_until_ready(metrics)
     elif stage == "step18":

@@ -20,7 +20,12 @@ autodiffs. Numerical equivalence with the XLA path is test-gated
 (tests/test_kernels.py), and `interpret=True` runs them on CPU.
 
 Layout: [B, S, C, H, W] with W on the 128-lane axis and H on sublanes; the
-grid walks (batch, H-tiles) and the plane loop is statically unrolled.
+grid walks (batch, H-tiles, W-tiles) and the plane loop is statically
+unrolled. Block planning is centralized in `_plan_blocks`: rows pad to the
+8-row sublane tile, W tiles over lane-aligned divisors when the minimum
+H-tile exceeds the VMEM budget, and lane-UNALIGNED widths that need
+W-tiling get zero column padding first (all exact — pixels are
+independent across H and W; the transparency chain reduces over S only).
 """
 
 from __future__ import annotations
@@ -61,6 +66,27 @@ def _pick_tile_h(H: int, W: int, S: int,
     return min(legal) if legal else H
 
 
+def _pick_tiles(H: int, W: int, S: int,
+                budget: int = 4 * 1024 * 1024,
+                rows_per_plane: int = 7) -> tuple:
+    """(TH, TW): H-tile as _pick_tile_h; if even the minimum H-tile blows
+    the budget, ALSO tile W over lane-aligned (128-multiple) divisors.
+
+    Needed on silicon (round-4 window): at the reference-exact 512-wide
+    scale 0 the backward composite's minimum 8-row block is 16.09M scoped
+    VMEM — 88K over the 16M limit. Pixels are independent across W (the
+    transparency chain reduces over S), so W-tiling is exact."""
+    TH = _pick_tile_h(H, W, S, budget, rows_per_plane)
+    if TH * S * rows_per_plane * W * 4 <= budget or W % 128:
+        return TH, W  # fits, or no lane-aligned divisor exists
+    legal_w = [d for d in range(128, W + 1, 128) if W % d == 0]
+    per_col = TH * S * rows_per_plane * 4
+    in_budget = [d for d in legal_w if d * per_col <= budget]
+    if in_budget:
+        return TH, max(in_budget)
+    return TH, min(legal_w)
+
+
 def pallas_tileable(H: int) -> bool:
     """True when H admits a Mosaic-legal tile — a divisor that is a multiple
     of 8, which exists iff 8 | H. Other heights (e.g. H=756 full-res eval)
@@ -75,15 +101,53 @@ def pad_rows(x: jnp.ndarray, pad: int) -> jnp.ndarray:
     return jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)])
 
 
-def padded_rows_call(fn, arrs, pad: int, real_H: int, **kw):
-    """THE pad-call-slice rule, shared by every kernel wrapper: pad each
-    (..., H, W) arg's row axis by `pad`, call fn, slice every output back
-    to real_H. Exact because the composite kernels reduce over S with
-    pixels independent across H (padded rows: sigma=0 -> weight 0)."""
-    out = fn(*(pad_rows(a, pad) for a in arrs), **kw)
+def _plan_blocks(H: int, W: int, S: int,
+                 budget: int = 4 * 1024 * 1024,
+                 rows_per_plane: int = 7) -> tuple:
+    """(TH, TW, cpad): THE block plan, one call per wrapper so the column
+    pad and the tile choice can never desynchronize (they share budget and
+    rows_per_plane by construction).
+
+    cpad > 0 means: re-enter the wrapper with cpad zero columns appended
+    (lane-UNALIGNED width that needs W-tiling — e.g. the S=64
+    coarse-to-fine 192-wide scale 1, a round-4 on-silicon scoped-VMEM
+    OOM); TH/TW are then for the PADDED width. Zero columns carry sigma=0
+    (weight 0) / zero cotangents, pixels are independent across W — exact
+    after slicing."""
+    if W % 128 and _pick_tiles(H, W, S, budget, rows_per_plane)[0] \
+            * S * rows_per_plane * W * 4 > budget:
+        return (*_pick_tiles(H, W + (-W) % 128, S, budget, rows_per_plane),
+                (-W) % 128)
+    return (*_pick_tiles(H, W, S, budget, rows_per_plane), 0)
+
+
+def _padded_axis_call(fn, arrs, pad: int, real: int, axis: int, **kw):
+    """THE pad-call-slice rule: zero-pad `axis` of each (..., H, W) arg,
+    call fn, slice every output back to `real`. Exact because the
+    composite kernels reduce over S with pixels independent across H and
+    W (padded rows/columns: sigma=0 -> weight 0; zero cotangents -> zero
+    grads)."""
+    def pad_one(a):
+        w = [(0, 0)] * a.ndim
+        w[axis] = (0, pad)
+        return jnp.pad(a, w)
+
+    out = fn(*(pad_one(a) for a in arrs), **kw)
+    index = (Ellipsis, slice(None, real), slice(None)) if axis == -2 \
+        else (Ellipsis, slice(None, real))
     if isinstance(out, tuple):
-        return tuple(o[..., :real_H, :] for o in out)
-    return out[..., :real_H, :]
+        return tuple(o[index] for o in out)
+    return out[index]
+
+
+def padded_cols_call(fn, arrs, pad: int, real_W: int, **kw):
+    """Column form of the pad-call-slice rule."""
+    return _padded_axis_call(fn, arrs, pad, real_W, -1, **kw)
+
+
+def padded_rows_call(fn, arrs, pad: int, real_H: int, **kw):
+    """Row form of the pad-call-slice rule (_padded_axis_call)."""
+    return _padded_axis_call(fn, arrs, pad, real_H, -2, **kw)
 
 
 def _tgt_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
@@ -131,6 +195,12 @@ def fused_volume_render(rgb_BS3HW: jnp.ndarray,
     behind-camera masking) returning (rgb [B,3,H,W], depth [B,1,H,W]).
     Any H is accepted (rows padded to a Mosaic-legal multiple of 8)."""
     B, S, _, real_H, W = rgb_BS3HW.shape
+    TH, TW, cpad = _plan_blocks(real_H + (-real_H) % 8, W, S)
+    if cpad:
+        return padded_cols_call(
+            fused_volume_render, (rgb_BS3HW, sigma_BS1HW, xyz_BS3HW),
+            cpad, W, z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
+            interpret=interpret)
     pad = (-real_H) % 8
     if pad:
         return padded_rows_call(
@@ -138,12 +208,11 @@ def fused_volume_render(rgb_BS3HW: jnp.ndarray,
             pad, real_H, z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
             interpret=interpret)
     H = real_H
-    TH = _pick_tile_h(H, W, S)
-    grid = (B, H // TH)
+    grid = (B, H // TH, W // TW)
 
     def vol_spec(C):
-        return pl.BlockSpec((1, S, C, TH, W),
-                            lambda b, h: (b, 0, 0, h, 0),
+        return pl.BlockSpec((1, S, C, TH, TW),
+                            lambda b, h, w: (b, 0, 0, h, w),
                             memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
@@ -151,9 +220,9 @@ def fused_volume_render(rgb_BS3HW: jnp.ndarray,
         grid=grid,
         in_specs=[vol_spec(3), vol_spec(1), vol_spec(3)],
         out_specs=[
-            pl.BlockSpec((1, 3, TH, W), lambda b, h: (b, 0, h, 0),
+            pl.BlockSpec((1, 3, TH, TW), lambda b, h, w: (b, 0, h, w),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, TH, W), lambda b, h: (b, 0, h, 0),
+            pl.BlockSpec((1, 1, TH, TW), lambda b, h, w: (b, 0, h, w),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -217,6 +286,13 @@ def fused_src_render_blend(rgb_BS3HW: jnp.ndarray,
     Any H is accepted (rows padded to a Mosaic-legal multiple of 8).
     """
     B, S, _, real_H, W = rgb_BS3HW.shape
+    TH, TW, cpad = _plan_blocks(real_H + (-real_H) % 8, W, S,
+                                rows_per_plane=10)  # +3: blended out vol
+    if cpad:
+        return padded_cols_call(
+            fused_src_render_blend,
+            (rgb_BS3HW, sigma_BS1HW, xyz_BS3HW, src_img_B3HW),
+            cpad, W, is_bg_depth_inf=is_bg_depth_inf, interpret=interpret)
     pad = (-real_H) % 8
     if pad:
         return padded_rows_call(
@@ -225,15 +301,14 @@ def fused_src_render_blend(rgb_BS3HW: jnp.ndarray,
             pad, real_H, is_bg_depth_inf=is_bg_depth_inf,
             interpret=interpret)
     H = real_H
-    TH = _pick_tile_h(H, W, S)
-    grid = (B, H // TH)
+    grid = (B, H // TH, W // TW)
 
     def vol_spec(C):
-        return pl.BlockSpec((1, S, C, TH, W),
-                            lambda b, h: (b, 0, 0, h, 0),
+        return pl.BlockSpec((1, S, C, TH, TW),
+                            lambda b, h, w: (b, 0, 0, h, w),
                             memory_space=pltpu.VMEM)
 
-    img_spec = pl.BlockSpec((1, 3, TH, W), lambda b, h: (b, 0, h, 0),
+    img_spec = pl.BlockSpec((1, 3, TH, TW), lambda b, h, w: (b, 0, h, w),
                             memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
@@ -242,7 +317,7 @@ def fused_src_render_blend(rgb_BS3HW: jnp.ndarray,
         in_specs=[vol_spec(3), vol_spec(1), vol_spec(3), img_spec],
         out_specs=[
             img_spec,
-            pl.BlockSpec((1, 1, TH, W), lambda b, h: (b, 0, h, 0),
+            pl.BlockSpec((1, 1, TH, TW), lambda b, h, w: (b, 0, h, w),
                          memory_space=pltpu.VMEM),
             vol_spec(3),
         ],

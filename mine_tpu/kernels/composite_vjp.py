@@ -25,13 +25,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mine_tpu.kernels.composite import (_pick_tile_h, fused_volume_render,
-                                        padded_rows_call)
+from mine_tpu.kernels.composite import (_plan_blocks, fused_volume_render,
+                                        padded_cols_call, padded_rows_call)
 
 
-def _pick_tile_h_bwd(H: int, W: int, S: int) -> int:
-    """Backward block: inputs+grads+outputs+scratch ~ 19 plane-sized rows."""
-    return _pick_tile_h(H, W, S, budget=5 * 1024 * 1024, rows_per_plane=19)
+def _plan_blocks_bwd(H: int, W: int, S: int):
+    """Backward block plan: inputs+grads+outputs+scratch ~ 19 plane-sized
+    rows. W-tiling kicks in at wide shapes — the 512-wide reference-exact
+    scale 0 was 88K over the 16M scoped-VMEM limit at the minimum 8-row
+    tile (round-4 on-silicon OOM; _plan_blocks docstring)."""
+    return _plan_blocks(H, W, S, budget=5 * 1024 * 1024, rows_per_plane=19)
 
 
 def _bwd_kernel(S: int, z_mask: bool, is_bg_depth_inf: bool,
@@ -127,6 +130,13 @@ def _composite_bwd(rgb, sigma, xyz, g_rgb, g_depth,
                    z_mask: bool, is_bg_depth_inf: bool,
                    interpret: bool = False):
     B, S, _, real_H, W = rgb.shape
+    TH, TW, cpad = _plan_blocks_bwd(real_H + (-real_H) % 8, W, S)
+    if cpad:
+        # zero-padded columns carry zero cotangents -> zero grads there
+        return padded_cols_call(
+            _composite_bwd, (rgb, sigma, xyz, g_rgb, g_depth), cpad, W,
+            z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
+            interpret=interpret)
     pad = (-real_H) % 8
     if pad:
         # padded rows carry sigma=0 and zero cotangents: their grads are 0
@@ -136,15 +146,15 @@ def _composite_bwd(rgb, sigma, xyz, g_rgb, g_depth,
             z_mask=z_mask, is_bg_depth_inf=is_bg_depth_inf,
             interpret=interpret)
     H = real_H
-    TH = _pick_tile_h_bwd(H, W, S)
-    grid = (B, H // TH)
+    grid = (B, H // TH, W // TW)
 
     def vol_spec(C):
-        return pl.BlockSpec((1, S, C, TH, W), lambda b, h: (b, 0, 0, h, 0),
+        return pl.BlockSpec((1, S, C, TH, TW),
+                            lambda b, h, w: (b, 0, 0, h, w),
                             memory_space=pltpu.VMEM)
 
     def img_spec(C):
-        return pl.BlockSpec((1, C, TH, W), lambda b, h: (b, 0, h, 0),
+        return pl.BlockSpec((1, C, TH, TW), lambda b, h, w: (b, 0, h, w),
                             memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
@@ -159,8 +169,8 @@ def _composite_bwd(rgb, sigma, xyz, g_rgb, g_depth,
             jax.ShapeDtypeStruct((B, S, 3, H, W), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((S, TH, W), jnp.float32),
-            pltpu.VMEM((S, TH, W), jnp.float32),
+            pltpu.VMEM((S, TH, TW), jnp.float32),
+            pltpu.VMEM((S, TH, TW), jnp.float32),
         ],
         interpret=interpret,
     )(rgb.astype(jnp.float32), sigma.astype(jnp.float32),

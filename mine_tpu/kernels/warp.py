@@ -44,6 +44,12 @@ def _warp_kernel(C: int, BAND: int, RT: int, H_s: int, W_s: int,
                  mxu_dtype, y0_ref, xc_ref, yc_ref, src_ref, out_ref,
                  band_buf, sem):
     W_t = xc_ref.shape[2]
+    # bf16 matmul operands compile only at lane-aligned output widths
+    # (Mosaic "Bad lhs type" at W_t=48 on silicon, round-4 window; the
+    # bench's W_t=384 was fine) — fall back to f32 elsewhere. No perf loss
+    # in practice: the banded kernels measured VPU-bound, not MXU-bound.
+    if W_t % 128:
+        mxu_dtype = jnp.float32
     # y0 comes in as the FULL [B', NB] table in SMEM (a (1,1) block would
     # violate the Mosaic last-two-dims tiling rule); index it by grid step.
     # band_start aligns it to the sublane tile; multiple_of carries that

@@ -56,6 +56,10 @@ def _bwd_kernel(C: int, OBAND: int, RS: int, H_t: int, W_t: int,
     """Grid step (b, source-row-block): splat OBAND gradient rows into RS
     source rows via transposed tent-weight contractions."""
     W_s = out_ref.shape[3]
+    # same bf16 lane-alignment constraint as the forward kernel (Mosaic
+    # "Bad lhs type" at non-128-multiple output widths on silicon)
+    if W_s % 128:
+        mxu_dtype = jnp.float32
     b = pl.program_id(0)
     sb = pl.program_id(1)
     # full [B', NBs] table in SMEM (a (1,1) block would violate the Mosaic

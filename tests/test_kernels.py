@@ -124,3 +124,29 @@ def test_tile_h_picker():
         th = _pick_tile_h(H, W, S)
         assert H % th == 0 and th >= 1
         assert S * 7 * W * 4 * th <= 8 * 1024 * 1024  # block fits VMEM budget
+
+
+def test_block_planner_tiles_width_at_wide_shapes():
+    """The bwd budget (19 rows/plane) at the reference-exact 512-wide scale
+    0 was 88K over the 16M scoped-VMEM limit at the minimum 8-row tile —
+    the round-4 on-silicon OOM. _plan_blocks must tile W there (lane-
+    aligned), request column padding at lane-UNALIGNED widths that need
+    tiling (the S=64 c2f 192-wide scale 1), and leave narrow/CPU-test
+    shapes un-tiled."""
+    from mine_tpu.kernels.composite import _plan_blocks
+
+    bwd = dict(budget=5 * 1024 * 1024, rows_per_plane=19)
+    th, tw, cpad = _plan_blocks(384, 512, 32, **bwd)
+    assert cpad == 0 and 512 % tw == 0 and tw % 128 == 0 and tw < 512
+    assert th * 32 * 19 * tw * 4 <= 5 * 1024 * 1024
+
+    th, tw, cpad = _plan_blocks(128, 192, 64, **bwd)  # c2f scale 1
+    assert cpad == 64  # pad 192 -> 256 to unlock lane-aligned tiling
+    assert (192 + cpad) % tw == 0 and tw % 128 == 0
+    assert th * 64 * 19 * tw * 4 <= 5 * 1024 * 1024
+
+    for H, W, S in [(256, 384, 32), (64, 64, 4), (32, 48, 4), (13, 17, 3)]:
+        th, tw, cpad = _plan_blocks(H, W, S, **bwd)
+        assert cpad == 0
+        assert tw == W or (W % tw == 0 and tw % 128 == 0)
+        assert H % th == 0

@@ -114,18 +114,12 @@ class MPIConfig:
     # "pallas_diff" = banded MXU kernel fwd+bwd, kernels/warp_vjp.py; both
     # carry a runtime gather fallback for rotation-heavy poses)
     warp_backend: str = "xla"
+    # fwd AND bwd band: since the round-4 transposed-splat backward the
+    # Pallas VJP mirrors the forward's band placement, so one knob covers
+    # both (the earlier backward-specific "oband" — sized for the 54+-row
+    # target touch spans of vertically-compressing near planes — is gone;
+    # the transposed form has no such constraint)
     warp_band: int = 48
-    # backward (gradient) band for the Pallas warp VJP. Measured need at
-    # bench poses (round-4 window, profiled per-scale): vertical
-    # COMPRESSION on the nearest plane makes one source row-block touched
-    # by far more target rows than the forward span, and the per-step
-    # scale factor (computed from network predictions, so wild at init —
-    # synthesis_task.py:211-220 semantics) multiplies the translation:
-    # at B=4 the batch-max scale-0 span exceeds 64 rows. 128 covers it
-    # with headroom; bwd MXU cost scales linearly with oband (≈19 ms/step
-    # measured for the kernel at oband=64 vs 4.5 s for the scale-0 gather
-    # fallback it replaces), fwd cost scales with warp_band.
-    warp_oband: int = 128
     # warp value dtype ("float32" | "bfloat16"): matmul operands in the
     # banded backends (bf16 doubles MXU rate) AND gather storage on the
     # default xla backend (bf16 halves the volume's HBM traffic); either
@@ -221,7 +215,6 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         composite_backend=backend,
         warp_backend=warp_backend,
         warp_band=int(g("training.warp_band", 48)),
-        warp_oband=int(g("training.warp_oband", 128)),
         warp_dtype=warp_dtype,
         # visible_point_count == 0 also disables the sparse-point terms —
         # datasets with no SfM points (public RealEstate10K) train scale-free

@@ -149,7 +149,6 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          backend: str = "xla",
                          warp_impl: str = "xla",
                          warp_band: int = 16,
-                         warp_oband: int = 64,
                          warp_dtype: str = "float32",
                          mesh=None) -> TgtRender:
     """Render the MPI into a target camera.
@@ -188,7 +187,6 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         grid,
         impl=warp_impl,
         band=warp_band,
-        oband=warp_oband,
         mesh=mesh,
         mxu_dtype=jnp.bfloat16 if warp_dtype == "bfloat16" else jnp.float32,
     )

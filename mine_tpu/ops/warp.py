@@ -130,7 +130,6 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     meshgrid_tgt: jnp.ndarray,
                     impl: str = "xla",
                     band: int = 16,
-                    oband: int = 64,
                     mesh=None,
                     mxu_dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Warp source-plane images into the target camera via inverse homography.
@@ -199,7 +198,7 @@ def homography_warp(src_BCHW: jnp.ndarray,
         from mine_tpu.kernels import on_tpu_backend
         from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
         fn = functools.partial(bilinear_sample_diff_guarded,
-                               band=band, oband=oband,
+                               band=band,
                                interpret=not on_tpu_backend(),
                                mxu_dtype=mxu_dtype)
         xs = jax.lax.stop_gradient(x)

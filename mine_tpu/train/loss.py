@@ -177,7 +177,6 @@ def loss_per_scale(scale: int,
             use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
             backend=cfg.composite_backend,
             warp_impl=cfg.warp_backend, warp_band=cfg.warp_band,
-            warp_oband=cfg.warp_oband,
             warp_dtype=cfg.warp_dtype,
             mesh=mesh if (mesh is not None and mesh.size > 1) else None)
     tgt_syn, tgt_mask = res.rgb, res.mask

@@ -84,7 +84,7 @@ def _stage_body(stage: str) -> None:
         jax.block_until_ready(out)
         # the training pair: banded forward + transposed-band backward
         g = jax.jit(jax.grad(
-            lambda s: jnp.sum(bilinear_sample_diff(s, cx, cy, 16, 16))))(src)
+            lambda s: jnp.sum(bilinear_sample_diff(s, cx, cy, 16, 8))))(src)
         jax.block_until_ready(g)
     else:
         raise ValueError(stage)

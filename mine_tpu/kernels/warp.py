@@ -222,7 +222,8 @@ def band_start(coords_y_clipped: jnp.ndarray, H_s: int, band: int,
 
 
 def fwd_domain_ok(coords_y: jnp.ndarray, H_s: int, band: int,
-                  rows_per_block: int = 8) -> jnp.ndarray:
+                  rows_per_block: int = 8,
+                  aligned: bool = True) -> jnp.ndarray:
     """Scalar bool (jit-safe): every row-block's source span fits the band.
 
     THE definition of the banded forward's correctness domain (span + 2
@@ -231,10 +232,15 @@ def fwd_domain_ok(coords_y: jnp.ndarray, H_s: int, band: int,
     (kernels/warp_vjp.py) and the pure-XLA banded warp (ops/warp_banded.py)
     so the two backends can never diverge on which poses count as in-band.
     coords_y must be border-clipped.
+
+    `aligned=False` drops the sublane-alignment slack from the budget: the
+    pure-XLA banded path keeps unaligned band starts (band_start docstring),
+    so it covers poses within SUBLANE_ALIGN-1 rows of the band limit that
+    the Pallas wrapper must send to the fallback.
     """
     eff = min(band, H_s)
-    return band_span(coords_y, H_s, rows_per_block) + 2.0 \
-        <= eff - _align_slack(eff, H_s)
+    slack = _align_slack(eff, H_s) if aligned else 0
+    return band_span(coords_y, H_s, rows_per_block) + 2.0 <= eff - slack
 
 
 def band_span(coords_y: jnp.ndarray, H_s: int,

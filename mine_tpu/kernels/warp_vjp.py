@@ -210,6 +210,17 @@ def diff_domain_ok(src_shape, coords_y, band: int,
     return fwd_domain_ok(yc, H_s, band, rows_per_block)
 
 
+def guard_ok(src_shape, coords_y, band: int = 48,
+             rows_per_block: int = 8) -> jnp.ndarray:
+    """THE fallback decision of bilinear_sample_diff_guarded, as a scalar
+    bool — exposed so diagnostics (ops/warp.homography_warp's
+    with_domain_flag) consume the same logic instead of mirroring it."""
+    H_t = coords_y.shape[1]
+    if H_t % rows_per_block != 0 or src_shape[2] % rows_per_block != 0:
+        return jnp.zeros((), jnp.bool_)
+    return diff_domain_ok(src_shape, coords_y, band, rows_per_block)
+
+
 def bilinear_sample_diff_guarded(src, coords_x, coords_y,
                                  band: int = 48,
                                  rows_per_block: int = 8,
@@ -235,7 +246,7 @@ def bilinear_sample_diff_guarded(src, coords_x, coords_y,
         return bilinear_sample(src, coords_x, coords_y,
                                gather_dtype=gather_dtype)
 
-    ok = diff_domain_ok(src.shape, coords_y, band, rows_per_block)
+    ok = guard_ok(src.shape, coords_y, band, rows_per_block)
     return jax.lax.cond(
         ok,
         lambda s, x, y: bilinear_sample_diff(

@@ -144,6 +144,12 @@ class MPIDecoder(nn.Module):
                               x, train)
             if not packed:  # packed stage 0 stays at stride 2 until its head
                 x = shard_bs(upsample_nearest_2x(x))
+            else:
+                # keep the B*S sharding constraint on the widest stage even
+                # though the packed branch skips the upsample it was
+                # attached to (advisor r4) — GSPMD would otherwise have to
+                # infer stage 0's layout on multi-device meshes
+                x = shard_bs(x)
             if self.use_skips and i > 0:
                 x = jnp.concatenate(
                     [x, expand_cat(features[i - 1].astype(dd))], axis=-1)

@@ -135,6 +135,10 @@ class TgtRender(NamedTuple):
     rgb: jnp.ndarray    # [B,3,H,W]
     depth: jnp.ndarray  # [B,1,H,W]
     mask: jnp.ndarray   # [B,1,H,W] — number of planes whose warp was in-bounds
+    # scalar f32 guard diagnostic: 1.0 = guarded warp backend took its fast
+    # path this call, 0.0 = runtime gather fallback, NaN = backend has no
+    # guard (ops/warp.homography_warp with_domain_flag)
+    warp_in_domain: jnp.ndarray = None
 
 
 def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
@@ -178,7 +182,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         return jnp.repeat(x, S, axis=0)  # [B,...] -> [B*S,...] (plane-major per b)
 
     grid = geometry.cached_pixel_grid(H, W)
-    warped, valid = warp.homography_warp(
+    warped, valid, warp_in_domain = warp.homography_warp(
         volume_bs,
         mpi_depth_src.reshape(B * S),
         expand(G_tgt_src),
@@ -189,6 +193,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         band=warp_band,
         mesh=mesh,
         mxu_dtype=jnp.bfloat16 if warp_dtype == "bfloat16" else jnp.float32,
+        with_domain_flag=True,
     )
 
     warped = warped.reshape(B, S, 7, H, W)
@@ -270,7 +275,8 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                                           is_bg_depth_inf=is_bg_depth_inf)
     mask = jnp.sum(valid.reshape(B, S, H, W).astype(jnp.float32),
                    axis=1, keepdims=True)  # [B,1,H,W]
-    return TgtRender(rgb=rgb_syn, depth=depth_syn, mask=mask)
+    return TgtRender(rgb=rgb_syn, depth=depth_syn, mask=mask,
+                     warp_in_domain=warp_in_domain)
 
 
 def predict_mpi_coarse_to_fine(mpi_predictor,

@@ -131,7 +131,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     impl: str = "xla",
                     band: int = 16,
                     mesh=None,
-                    mxu_dtype=jnp.float32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    mxu_dtype=jnp.float32,
+                    with_domain_flag: bool = False):
     """Warp source-plane images into the target camera via inverse homography.
 
     For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
@@ -160,8 +161,17 @@ def homography_warp(src_BCHW: jnp.ndarray,
         B' axis split over data*plane (matching the decoder's B*S layout,
         models/decoder.py shard_bs) — each device warps its local planes,
         no cross-device traffic.
+      with_domain_flag: also return `in_domain`, a scalar f32 diagnostic —
+        1.0 when the guarded banded backends (pallas_diff / xla_banded)
+        take their fast path for THIS call's poses, 0.0 when the runtime
+        guard sends the whole call to the gather fallback, NaN for
+        backends with no guard (plain xla / forward-only pallas). Under a
+        sharded mesh the per-device cond may differ per shard; this global
+        flag is the conservative all-shards-fast indicator. Powers the
+        `warp_fallback_frac` training metric (VERDICT r4 weak item 5).
     Returns:
       tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
+      [, in_domain scalar f32 — only when with_domain_flag]
     """
     Bp, C, H, W = src_BCHW.shape
     _, Ht, Wt = meshgrid_tgt.shape
@@ -177,6 +187,10 @@ def homography_warp(src_BCHW: jnp.ndarray,
 
     valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
 
+    # diagnostic only — mirrors each guarded backend's fallback decision
+    # (NaN = backend has no runtime guard to measure)
+    in_domain = jnp.full((), jnp.nan, jnp.float32)
+
     if impl == "pallas":
         from mine_tpu.kernels import on_tpu_backend
         from mine_tpu.kernels.warp import pallas_bilinear_sample
@@ -186,10 +200,13 @@ def homography_warp(src_BCHW: jnp.ndarray,
         # banded one-hot-matmul warp in pure XLA (ops/warp_banded.py):
         # differentiable by autodiff and GSPMD-partitionable directly, so
         # no shard_map wrapper or mesh-divisibility guard is needed
-        from mine_tpu.ops.warp_banded import banded_bilinear_sample_guarded
-        tgt = banded_bilinear_sample_guarded(
-            src_BCHW, jax.lax.stop_gradient(x), jax.lax.stop_gradient(y),
-            band=band, mxu_dtype=mxu_dtype)
+        from mine_tpu.ops import warp_banded
+        xs = jax.lax.stop_gradient(x)
+        ys = jax.lax.stop_gradient(y)
+        in_domain = warp_banded.guard_ok(
+            src_BCHW.shape, ys, band).astype(jnp.float32)
+        tgt = warp_banded.banded_bilinear_sample_guarded(
+            src_BCHW, xs, ys, band=band, mxu_dtype=mxu_dtype)
     elif impl == "pallas_diff":
         # training path: banded Pallas fwd+bwd with runtime gather fallback
         # outside the band domain (kernels/warp_vjp.py). Coords are
@@ -203,6 +220,9 @@ def homography_warp(src_BCHW: jnp.ndarray,
                                mxu_dtype=mxu_dtype)
         xs = jax.lax.stop_gradient(x)
         ys = jax.lax.stop_gradient(y)
+        from mine_tpu.kernels.warp_vjp import guard_ok as _diff_guard_ok
+        in_domain = _diff_guard_ok(src_BCHW.shape, ys,
+                                   band).astype(jnp.float32)
         if mesh is not None and mesh.size > 1:
             if Bp % mesh.size == 0:
                 # split the flat B' (=B*S, B-major) axis over data*plane:
@@ -225,9 +245,12 @@ def homography_warp(src_BCHW: jnp.ndarray,
                 # keep the reduced-precision storage knob on this path too
                 fn = functools.partial(bilinear_sample,
                                        gather_dtype=mxu_dtype)
+                in_domain = jnp.zeros((), jnp.float32)
         tgt = fn(src_BCHW, xs, ys)
     else:
         # training.warp_dtype reaches the gather too: bf16 storage halves
         # the volume's HBM traffic, lerp stays f32 (f32 is a no-op knob)
         tgt = bilinear_sample(src_BCHW, x, y, gather_dtype=mxu_dtype)
+    if with_domain_flag:
+        return tgt, valid, in_domain
     return tgt, valid

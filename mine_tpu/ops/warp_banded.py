@@ -100,6 +100,23 @@ def banded_bilinear_sample(src: jnp.ndarray,
     return blocks.transpose(2, 3, 0, 1, 4).reshape(Bp, C, H_t, W_t)
 
 
+def guard_ok(src_shape, coords_y, band: int = 16,
+             rows_per_block: int = 8) -> jnp.ndarray:
+    """THE fallback decision of banded_bilinear_sample_guarded, as a scalar
+    bool — exposed so diagnostics (ops/warp.homography_warp's
+    with_domain_flag) consume the same logic instead of mirroring it.
+
+    aligned=False: this path keeps unaligned band starts, so it need not
+    budget the Pallas sublane slack — poses within SUBLANE_ALIGN-1 rows of
+    the band limit stay on the fast path here (advisor r4)."""
+    H_s = src_shape[2]
+    H_t = coords_y.shape[1]
+    if H_t % rows_per_block != 0:
+        return jnp.zeros((), jnp.bool_)
+    yc = jnp.clip(coords_y, 0.0, H_s - 1.0)
+    return fwd_domain_ok(yc, H_s, band, rows_per_block, aligned=False)
+
+
 def banded_bilinear_sample_guarded(src, coords_x, coords_y,
                                    band: int = 16,
                                    rows_per_block: int = 8,
@@ -123,9 +140,7 @@ def banded_bilinear_sample_guarded(src, coords_x, coords_y,
         return bilinear_sample(src, coords_x, coords_y,
                                gather_dtype=gather_dtype)
 
-    H_s = src.shape[2]
-    yc = jnp.clip(coords_y, 0.0, H_s - 1.0)
-    ok = fwd_domain_ok(yc, H_s, band, rows_per_block)
+    ok = guard_ok(src.shape, coords_y, band, rows_per_block)
     return jax.lax.cond(
         ok,
         lambda s, x, y: banded_bilinear_sample(

@@ -276,6 +276,11 @@ def loss_per_scale(scale: int,
         "psnr_tgt": psnr_tgt,
         "loss_disp_pt3dtgt": loss_disp_tgt,
     }
+    if cfg.warp_backend in ("pallas_diff", "xla_banded"):
+        # guard diagnostic, not a loss: 1.0 when this scale's guarded warp
+        # backend bailed to the gather (key absent on unguarded backends)
+        loss_dict["warp_fallback"] = jax.lax.stop_gradient(
+            1.0 - res.warp_in_domain)
     visuals = {
         "src_disparity_syn": src_disp_syn,
         "tgt_disparity_syn": tgt_disp_syn,
@@ -324,4 +329,11 @@ def compute_losses(mpi_list,
 
     metrics = dict(dicts[0])
     metrics["loss"] = total
+    if "warp_fallback" in metrics:
+        # fraction of this step's 4 scale-warps that hit the gather
+        # fallback (VERDICT r4 weak item 5 — anchors the `auto` backend's
+        # perf claim); key absent for backends with no runtime guard
+        del metrics["warp_fallback"]
+        metrics["warp_fallback_frac"] = jnp.mean(
+            jnp.stack([d["warp_fallback"] for d in dicts]))
     return total, metrics, visuals0

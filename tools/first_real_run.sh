@@ -35,7 +35,12 @@ while [ $# -gt 0 ]; do
         --workspace)  WS=$2; shift 2 ;;
         --ratio)      RATIO=$2; shift 2 ;;
         --extra)      EXTRA=$2; shift 2 ;;
-        --fixture)    FIXTURE=1; [ $# -gt 1 ] && { WS=$2; shift; }; shift ;;
+        # optional WORKDIR operand: only consume it when it isn't a flag
+        --fixture)    FIXTURE=1
+                      if [ $# -gt 1 ]; then
+                          case "$2" in -*) ;; *) WS=$2; shift ;; esac
+                      fi
+                      shift ;;
         *) echo "unknown arg: $1" >&2; exit 2 ;;
     esac
 done

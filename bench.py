@@ -61,6 +61,14 @@ import time
 # Reference estimate: MINE on 2x V100 (B=2/GPU, fp32, 384x256, N=32).
 # See BASELINE.md "Estimated reference throughput" for the derivation.
 ESTIMATED_REFERENCE_IMAGES_PER_SEC = 4.0
+# Documented spread of that estimate (BASELINE.md) — vs_baseline_range
+# reports the multiplier at both edges instead of pretending the point
+# denominator is exact.
+REFERENCE_IMAGES_PER_SEC_SPREAD = (2.0, 6.0)
+# FLOPs-grounded hard ceiling: 2x V100 fp32 peak (31.4 TFLOP/s) at 40%
+# utilization over ~1.13 TFLOP/image (BASELINE.md "FLOPs-grounded
+# bracket") — the reference cannot physically exceed this.
+REFERENCE_FLOPS_CEILING_IMAGES_PER_SEC = 11.1
 
 # bf16 peak of the one available chip (v5e) — the physics bound for the
 # per-variant sanity audit (see run-variant suspect check). Override if the
@@ -103,12 +111,23 @@ VARIANTS = {
                    "training.composite_backend": "xla"}),
     "pallas_b4": (4, {"training.warp_backend": "pallas_diff",
                       "training.composite_backend": "pallas_diff"}),
-    "xlabanded_b4": (4, {"training.warp_backend": "xla_banded"}),
+    # xlabanded_* variants REMOVED from the sweep (round 5): the full
+    # train step with warp_backend=xla_banded reliably crashes the remote
+    # compiler ("tpu_compile_helper subprocess exit code 1") at BOTH
+    # resnet50 and resnet18 depths, while the guarded banded warp's
+    # fwd+grad compile AND run standalone at every loss-scale shape
+    # (256x384 down to 32x48) — the failure is compositional and
+    # server-side, not in the op (bisect: BENCH_NOTES_r05.md). The
+    # backend stays available (CPU/tests green; gather remains the
+    # runtime fallback tier) but is not measurable on this toolchain.
     "pallas_bf16_b4": (4, {"training.warp_backend": "pallas_diff",
                            "training.composite_backend": "pallas_diff",
                            "training.warp_dtype": "bfloat16"}),
-    "xlabanded_bf16_b4": (4, {"training.warp_backend": "xla_banded",
-                              "training.warp_dtype": "bfloat16"}),
+    # band32_b4/band24_b4 MEASURED round 5 and removed: warp_band
+    # right-sizing is domain-limited — at bench poses the guard rejects
+    # bands narrower than 48 and every step gather-falls-back (0.707 /
+    # 0.605 img/s). 48 is the empirical floor; the guard + the
+    # warp_fallback_frac metric made the experiment semantics-safe.
     # NOTE round 4: variants below inherit the shipped "auto" backends
     # (pallas on TPU). Names no longer carry an xla_ prefix — a prefixed
     # name measuring the Pallas path would corrupt cross-round comparisons
@@ -460,6 +479,14 @@ def main():
         # SMOKE throughput is meaningless against the real-config estimate
         "vs_baseline": None if SMOKE else round(
             best_ips / ESTIMATED_REFERENCE_IMAGES_PER_SEC, 3),
+        # the denominator is an estimate with a documented spread — report
+        # the multiplier at both edges, plus the value against the
+        # reference's FLOPs-derived physical ceiling (BASELINE.md)
+        "vs_baseline_range": None if SMOKE else [
+            round(best_ips / REFERENCE_IMAGES_PER_SEC_SPREAD[1], 3),
+            round(best_ips / REFERENCE_IMAGES_PER_SEC_SPREAD[0], 3)],
+        "vs_reference_flops_ceiling": None if SMOKE else round(
+            best_ips / REFERENCE_FLOPS_CEILING_IMAGES_PER_SEC, 3),
         "best_config": best_name,
         "variants": results,
     }

@@ -120,10 +120,16 @@ class TrainLoop:
             epochs: Optional[int] = None) -> TrainState:
         if state is None:
             state = self.trainer.init_state(self.trainer.global_batch_size())
-            restored = self.ckpt.restore(state)
-            if restored is not None:
-                state = restored
-                self._log("Resumed from checkpoint at step %d" % int(state.step))
+        # Resume is attempted for a PASSED state too — train_cli always
+        # passes one (it may carry pretrained weights), and gating restore
+        # on `state is None` silently restarted CLI runs from scratch
+        # (caught by the r5 on-TPU soak's kill/resume leg). A workspace
+        # checkpoint outranks pretrained init, like the reference's
+        # resume-from-workspace flow (synthesis_task.py:121-136).
+        restored = self.ckpt.restore(state)
+        if restored is not None:
+            state = restored
+            self._log("Resumed from checkpoint at step %d" % int(state.step))
 
         epochs = epochs or int(self.config.get("training.epochs", 1))
         steps_per_epoch = self.trainer.steps_per_epoch
@@ -135,6 +141,13 @@ class TrainLoop:
                 self._log("Epoch %d finished, average losses:" % epoch)
                 for m in self.train_meters.values():
                     self._log("    %s" % m)
+        # final save: runs shorter than checkpoint_interval otherwise leave
+        # NO checkpoint_latest at all — the fixture end-to-end chain dies at
+        # eval and a killed short run has nothing to resume from (advisor
+        # r5; collective, every process participates)
+        self.ckpt.save_latest(state)
+        if self.is_lead:
+            self._log("Final checkpoint saved at step %d" % int(state.step))
         self.ckpt.wait()
         return state
 
@@ -289,7 +302,15 @@ class TrainLoop:
                m["loss_rgb_src"], m["loss_ssim_src"], m["loss_disp_pt3dsrc"],
                m["loss_rgb_tgt"], m["loss_ssim_tgt"], m["loss_disp_pt3dtgt"],
                m["psnr_tgt"]))
+        # diagnostics beyond the fixed reference meter set (e.g.
+        # warp_fallback_frac from the guarded warp backends) get meters on
+        # first sight so they reach the epoch summaries and TB too
+        for k in m:
+            if k not in self.train_meters:
+                self.train_meters[k] = AverageMeter("train_" + k)
         for k, meter in self.train_meters.items():
+            if k not in m:
+                continue  # meter from a previous backend config
             meter.update(m[k])
             if self.tb is not None:
                 self.tb.add_scalar(k + "/train", m[k], gstep)

@@ -87,6 +87,10 @@ MEDIUM_FILES = {
     "test_checkpoint.py",
     "test_loss_aggregation.py",
     "test_packed_decoder.py",
+    # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
+    # eval): the closest thing to a real-data rehearsal, gated here so it
+    # can't rot (round-4 VERDICT item 8; ~5 min of the tier's budget)
+    "test_first_real_run.py",
 }
 
 

@@ -37,7 +37,6 @@ def test_preflight_missing_checkpoint_names_the_grid(tmp_path):
     assert "README.md:43-50" in r.stderr  # points at the released grid
 
 
-@pytest.mark.slow
 def test_fixture_mode_end_to_end(tmp_path):
     ws = str(tmp_path / "ws")
     r = _run(["--fixture", ws], timeout=1800)

@@ -124,16 +124,22 @@ class MPIDecoder(nn.Module):
             the chunking lines up with [B/data, S/plane] blocks per device)."""
             return constrain(t, self.mesh, (DATA_AXIS, PLANE_AXIS))
 
-        def expand_cat(feat):
-            """[B,h,w,C] -> [B*S,h,w,C+E] with the plane embedding appended."""
+        def expand(feat):
+            """[B,h,w,C] -> [B*S,h,w,C] (plane-major per example)."""
             _, h, w, C = feat.shape
             f = jnp.broadcast_to(feat[:, None], (B, S, h, w, C))
-            f = f.reshape(B * S, h, w, C)
-            e = jnp.broadcast_to(emb[:, None, None, :],
-                                 (B * S, h, w, emb.shape[-1]))
-            return shard_bs(jnp.concatenate([f, e], axis=-1))
+            return shard_bs(f.reshape(B * S, h, w, C))
 
-        x = expand_cat(x)  # replaces features[-1] as the decoder stem
+        # The plane embedding is spatially CONSTANT, so every conv that
+        # consumes an [..., E]-suffixed concat instead receives the E
+        # values as a const_tail (layers.Conv): identical parameters and
+        # math (reflect padding preserves constants — the conv's E-channel
+        # contribution is exactly a per-plane bias), but the [B*S, h, w, E]
+        # broadcasts are never materialized, convolved, or differentiated.
+        # The kernel channel order stays [x, skip, emb] / [neck, emb], so
+        # converted reference checkpoints drop in unchanged.
+        x = expand(x)  # replaces features[-1] as the decoder stem
+        tail = emb     # pending const-tail for the NEXT ConvBlock
 
         outputs = {}
         for i in range(4, -1, -1):
@@ -141,7 +147,8 @@ class MPIDecoder(nn.Module):
             width = NUM_CH_DEC[i] * (4 if packed else 1)
             x = ConvBlock(width, dtype=self.dtype,
                           name=f"upconv_{i}_0{'p' if packed else ''}")(
-                              x, train)
+                              x, train, const_tail=tail)
+            tail = None
             if not packed:  # packed stage 0 stays at stride 2 until its head
                 x = shard_bs(upsample_nearest_2x(x))
             else:
@@ -152,10 +159,12 @@ class MPIDecoder(nn.Module):
                 x = shard_bs(x)
             if self.use_skips and i > 0:
                 x = jnp.concatenate(
-                    [x, expand_cat(features[i - 1].astype(dd))], axis=-1)
+                    [x, expand(features[i - 1].astype(dd))], axis=-1)
+                tail = emb
             x = ConvBlock(width, dtype=self.dtype,
                           name=f"upconv_{i}_1{'p' if packed else ''}")(
-                              x, train)
+                              x, train, const_tail=tail)
+            tail = None
             if i in self.scales:
                 out = Conv(self.num_output_channels * (4 if packed else 1),
                            3, pad_mode="reflect", dtype=self.dtype,

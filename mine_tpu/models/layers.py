@@ -30,8 +30,62 @@ def torch_bias_init(key, shape, dtype, fan_in: int):
     return jax.random.uniform(key, shape, dtype, -bound, bound)
 
 
+class _SplitTailConv(nn.Module):
+    """Conv whose last `tail` input channels are spatially CONSTANT.
+
+    Holds the FULL [k, k, C+E, F] kernel (checkpoint-identical to the
+    plain conv over the concatenated input) but receives only the first C
+    channels as a tensor plus the E constant values per batch element.
+    Because a constant map stays constant under reflect padding, the conv's
+    contribution from those channels is exactly a per-example bias:
+    values @ sum_kl W[k, l, C:, :]. Skipping them saves materializing,
+    convolving, and differentiating a [B, H, W, E] broadcast — the
+    positional-encoding channels of the MPI decoder's skip concats
+    (models/decoder.py, the const-tail block above its stage loop;
+    measured r5, BENCH_NOTES_r05.md).
+    """
+    features: int
+    kernel_size: int
+    full_in: int           # C + E — the checkpoint kernel's fan-in
+    strides: int
+    padding: Tuple          # lax-style ((t, b), (l, r)) spatial padding
+    use_bias: bool
+    kernel_init: Callable
+    bias_init: Callable
+    dtype: Optional[Dtype]
+
+    @nn.compact
+    def __call__(self, x, tail_values):
+        k = self.kernel_size
+        kernel = self.param("kernel", self.kernel_init,
+                            (k, k, self.full_in, self.features), jnp.float32)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          jnp.float32) if self.use_bias else None
+        C = x.shape[-1]
+        assert C + tail_values.shape[-1] == self.full_in, \
+            (C, tail_values.shape, self.full_in)
+        dt = self.dtype or jnp.promote_types(x.dtype, jnp.float32)
+        y = jax.lax.conv_general_dilated(
+            x.astype(dt), kernel[:, :, :C, :].astype(dt),
+            window_strides=(self.strides, self.strides),
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        w_tail = jnp.sum(kernel[:, :, C:, :], axis=(0, 1))  # [E, F]
+        y = y + (tail_values.astype(dt) @ w_tail.astype(dt))[:, None, None, :]
+        if bias is not None:
+            y = y + bias.astype(dt)
+        return y
+
+
 class Conv(nn.Module):
-    """NHWC conv with torch-style symmetric padding and init."""
+    """NHWC conv with torch-style symmetric padding and init.
+
+    `const_tail` ([B, E], optional call arg): the conv behaves as if the
+    input were concat([x, broadcast(const_tail)], -1) — same parameter
+    shapes/paths as that conv — without the broadcast ever existing (see
+    _SplitTailConv). Only valid with reflect padding (or none): zero
+    padding breaks the constant-map identity at borders.
+    """
     features: int
     kernel_size: int = 3
     strides: int = 1
@@ -42,7 +96,7 @@ class Conv(nn.Module):
     dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, const_tail=None):
         k = self.kernel_size
         p = (k - 1) // 2 if self.padding is None else self.padding
         if p > 0 and self.pad_mode == "reflect":
@@ -50,7 +104,20 @@ class Conv(nn.Module):
             pad = ((0, 0), (0, 0))
         else:
             pad = ((p, p), (p, p))
-        fan_in = k * k * x.shape[-1]
+        tail = 0 if const_tail is None else const_tail.shape[-1]
+        fan_in = k * k * (x.shape[-1] + tail)
+        bias_init = lambda key, shape, dtype=jnp.float32: torch_bias_init(  # noqa: E731
+            key, shape, dtype, fan_in)
+        if const_tail is not None:
+            assert self.pad_mode == "reflect" or p == 0, \
+                "const_tail needs reflect (or no) padding"
+            return _SplitTailConv(
+                features=self.features, kernel_size=k,
+                full_in=x.shape[-1] + tail,
+                strides=self.strides, padding=pad,
+                use_bias=self.use_bias, kernel_init=self.kernel_init,
+                bias_init=bias_init, dtype=self.dtype,
+                name="conv")(x, const_tail)
         conv = nn.Conv(
             features=self.features,
             kernel_size=(k, k),
@@ -58,8 +125,7 @@ class Conv(nn.Module):
             padding=pad,
             use_bias=self.use_bias,
             kernel_init=self.kernel_init,
-            bias_init=lambda key, shape, dtype=jnp.float32: torch_bias_init(
-                key, shape, dtype, fan_in),
+            bias_init=bias_init,
             dtype=self.dtype,
             name="conv",
         )
@@ -122,9 +188,9 @@ class ConvBlock(nn.Module):
     dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, const_tail=None):
         x = Conv(self.features, 3, pad_mode="reflect", dtype=self.dtype,
-                 name="conv3x3")(x)
+                 name="conv3x3")(x, const_tail=const_tail)
         x = BatchNorm(use_running_average=not train, dtype=self.dtype,
                       name="bn")(x)
         return nn.elu(x)

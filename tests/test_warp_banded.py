@@ -126,3 +126,39 @@ def test_trainer_accepts_xla_banded():
              make_batch(1, 32, 32, num_points=32).items()}
     state, metrics = trainer.train_step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_homography_warp_domain_flag_tracks_guard():
+    """with_domain_flag (the warp_fallback_frac metric's source) reports the
+    guarded backends' actual fallback decision: 1.0 for a translation-only
+    pose, 0.0 for a rotation-heavy one, NaN for the unguarded gather."""
+    from mine_tpu import geometry
+    B, C, H, W = 2, 3, 32, 32
+    src = jax.random.uniform(jax.random.PRNGKey(7), (B, C, H, W))
+    d = jnp.linspace(1.0, 4.0, B)
+    K = jnp.asarray(geometry.intrinsics_from_fov(H, W, 60.0))[None].repeat(B, 0)
+    K_inv = geometry.inverse_intrinsics(K)
+    grid = geometry.cached_pixel_grid(H, W)
+
+    G_mild = jnp.eye(4)[None].repeat(B, 0).at[:, 0, 3].set(0.02)
+    a = 0.6  # strong in-plane rotation -> source rows sweep the image
+    R = jnp.asarray([[np.cos(a), -np.sin(a), 0.0, 0.0],
+                     [np.sin(a), np.cos(a), 0.0, 0.0],
+                     [0.0, 0.0, 1.0, 0.0],
+                     [0.0, 0.0, 0.0, 1.0]], jnp.float32)
+    G_rot = jnp.broadcast_to(R, (B, 4, 4))
+
+    for impl in ("xla_banded", "pallas_diff"):
+        kw = dict(impl=impl, band=16)
+        if impl == "pallas_diff":
+            kw["band"] = 24  # pallas guard budgets alignment slack
+        _, _, ok_mild = homography_warp(src, d, G_mild, K_inv, K, grid,
+                                        with_domain_flag=True, **kw)
+        _, _, ok_rot = homography_warp(src, d, G_rot, K_inv, K, grid,
+                                       with_domain_flag=True, **kw)
+        assert float(ok_mild) == 1.0, (impl, float(ok_mild))
+        assert float(ok_rot) == 0.0, (impl, float(ok_rot))
+
+    _, _, flag = homography_warp(src, d, G_mild, K_inv, K, grid,
+                                 impl="xla", with_domain_flag=True)
+    assert np.isnan(float(flag))

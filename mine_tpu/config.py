@@ -125,6 +125,12 @@ class MPIConfig:
     # default xla backend (bf16 halves the volume's HBM traffic); either
     # way ~2^-8 relative value rounding, accumulation/lerp stays f32
     warp_dtype: str = "float32"
+    # SSIM Toeplitz-einsum matmul precision ("highest" | "default"):
+    # "highest" forces f32 MXU passes for the 11x11 Gaussian blur —
+    # matches the reference's conv2d numerics exactly; "default" lets the
+    # platform pick (bf16 passes on TPU: ~2e-3 blur / ~3e-3 SSIM shift,
+    # but 57ms -> 2ms on v5e). Mirrors the warp_dtype speed/accuracy knob.
+    ssim_precision: str = "highest"
     use_disparity_loss: bool = True   # disp_lambda=0 for flowers/kitti_raw/dtu
     use_scale_factor: bool = True     # scale_factor=1 for flowers/kitti_raw/dtu
     img_h: int = 384
@@ -194,6 +200,11 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         raise ValueError(
             f"training.warp_dtype must be float32|bfloat16, "
             f"got {warp_dtype!r}")
+    ssim_precision = g("training.ssim_precision", "highest")
+    if ssim_precision not in ("highest", "default"):
+        raise ValueError(
+            f"training.ssim_precision must be highest|default, "
+            f"got {ssim_precision!r}")
     return MPIConfig(
         num_bins_coarse=g("mpi.num_bins_coarse", 32),
         num_bins_fine=g("mpi.num_bins_fine", 0),
@@ -216,6 +227,7 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         warp_backend=warp_backend,
         warp_band=int(g("training.warp_band", 48)),
         warp_dtype=warp_dtype,
+        ssim_precision=ssim_precision,
         # visible_point_count == 0 also disables the sparse-point terms —
         # datasets with no SfM points (public RealEstate10K) train scale-free
         use_disparity_loss=(name not in _NO_DISP_DATASETS

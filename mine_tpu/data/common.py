@@ -6,6 +6,15 @@ train.py:83-87, synthesis_task.py:184-209) used by the LLFF, RealEstate10K,
 and synthetic loaders, so the semantics (shuffle the GLOBAL index list with
 the epoch-seeded RNG, then stride-shard across hosts — DistributedSampler
 order) cannot drift between them.
+
+Batch assembly is COUNTER-BASED: every item draws from its own PRNG stream
+keyed by (seed, epoch, position-in-shard-order), so batch b is a pure
+function of (dataset, seed, epoch, b). That makes the sequence independent
+of who assembles it — the sequential loop below and the multi-worker
+threaded assembler (mine_tpu.data.pipeline) produce bitwise-identical
+batches, and an interrupted run reproduces batch k exactly on resume.
+(The pre-pipeline implementation threaded ONE RandomState through all
+items in consumption order, which serializes assembly by construction.)
 """
 
 from __future__ import annotations
@@ -13,6 +22,64 @@ from __future__ import annotations
 from typing import Callable, Dict, Iterator, List, Tuple
 
 import numpy as np
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer — decorrelates nearby (seed, epoch, position)
+    keys into independent-looking 64-bit values."""
+    mask = (1 << 64) - 1
+    x = (x + 0x9E3779B97F4A7C15) & mask
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & mask
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & mask
+    return x ^ (x >> 31)
+
+
+def item_rng(seed: int, epoch: int, position: int) -> np.random.RandomState:
+    """The PRNG stream of one item slot.
+
+    `position` is the index into the host's shard order (NOT the dataset
+    index): two epochs sampling the same item get different streams, and
+    the stream does not depend on worker count or consumption order.
+    """
+    key = _mix64(((int(seed) + 1) << 40)
+                 ^ ((int(epoch) + 1) << 20)
+                 ^ int(position))
+    return np.random.RandomState(key % (1 << 32))
+
+
+def shard_order(num_items: int, shuffle: bool, seed: int, epoch: int,
+                shard_index: int, num_shards: int) -> np.ndarray:
+    """This host's item order: epoch-seeded global shuffle, then stride-shard
+    (DistributedSampler semantics)."""
+    order = np.arange(num_items)
+    if shuffle:
+        np.random.RandomState(seed + epoch).shuffle(order)
+    return order[shard_index::num_shards]
+
+
+def num_batches(num_items: int, batch_size: int, drop_last: bool) -> int:
+    if drop_last:
+        return num_items // batch_size
+    return -(-num_items // batch_size)
+
+
+def assemble_batch(get_pair: Callable[[int, np.random.RandomState],
+                                      Tuple[Dict, Dict]],
+                   order: np.ndarray,
+                   batch_index: int,
+                   batch_size: int,
+                   seed: int,
+                   epoch: int) -> Dict[str, np.ndarray]:
+    """Assemble + collate batch `batch_index` of the shard order.
+
+    Pure in (order, batch_index, seed, epoch): any worker can build any
+    batch, in any order, and get the same bytes.
+    """
+    lo = batch_index * batch_size
+    idxs = order[lo:lo + batch_size]
+    pairs = [get_pair(int(idx), item_rng(seed, epoch, lo + j))
+             for j, idx in enumerate(idxs)]
+    return collate_pairs(pairs)
 
 
 def iterate_pair_batches(num_items: int,
@@ -24,22 +91,30 @@ def iterate_pair_batches(num_items: int,
                          epoch: int = 0,
                          drop_last: bool = True,
                          shard_index: int = 0,
-                         num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
-    """Yield collated framework batches of (src, tgt) item pairs."""
-    order = np.arange(num_items)
-    if shuffle:
-        np.random.RandomState(seed + epoch).shuffle(order)
-    order = order[shard_index::num_shards]
+                         num_shards: int = 1,
+                         workers: int = 0,
+                         prefetch_batches: int = 2
+                         ) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield collated framework batches of (src, tgt) item pairs.
 
-    rng = np.random.RandomState((seed + 1) * 7919 + epoch)
-    batch: List = []
-    for idx in order:
-        batch.append(get_pair(int(idx), rng))
-        if len(batch) == batch_size:
-            yield collate_pairs(batch)
-            batch = []
-    if batch and not drop_last:
-        yield collate_pairs(batch)
+    workers=0: assemble on the calling thread (the original synchronous
+    path). workers>0: delegate to the threaded assembler
+    (mine_tpu.data.pipeline.threaded_pair_batches) — same batch sequence,
+    assembled by a worker pool with at most ~max(workers, prefetch_batches)
+    batches in flight.
+    """
+    if workers > 0:
+        from mine_tpu.data.pipeline import threaded_pair_batches
+        yield from threaded_pair_batches(
+            num_items, get_pair, batch_size, shuffle, seed=seed, epoch=epoch,
+            drop_last=drop_last, shard_index=shard_index,
+            num_shards=num_shards, workers=workers,
+            prefetch_batches=prefetch_batches)
+        return
+    order = shard_order(num_items, shuffle, seed, epoch, shard_index,
+                        num_shards)
+    for b in range(num_batches(len(order), batch_size, drop_last)):
+        yield assemble_batch(get_pair, order, b, batch_size, seed, epoch)
 
 
 def collate_pairs(pairs) -> Dict[str, np.ndarray]:

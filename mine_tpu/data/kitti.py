@@ -141,9 +141,13 @@ class KITTIRawDataset:
                        epoch: int = 0,
                        drop_last: bool = True,
                        shard_index: int = 0,
-                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+                       num_shards: int = 1,
+                       workers: int = 0,
+                       prefetch_batches: int = 2
+                       ) -> Iterator[Dict[str, np.ndarray]]:
         from mine_tpu.data.common import iterate_pair_batches
         yield from iterate_pair_batches(
             len(self), self.get_item, batch_size, shuffle, seed=seed,
             epoch=epoch, drop_last=drop_last, shard_index=shard_index,
-            num_shards=num_shards)
+            num_shards=num_shards, workers=workers,
+            prefetch_batches=prefetch_batches)

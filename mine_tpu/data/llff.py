@@ -213,7 +213,10 @@ class LLFFDataset:
                        epoch: int = 0,
                        drop_last: bool = True,
                        shard_index: int = 0,
-                       num_shards: int = 1) -> Iterator[Dict[str, np.ndarray]]:
+                       num_shards: int = 1,
+                       workers: int = 0,
+                       prefetch_batches: int = 2
+                       ) -> Iterator[Dict[str, np.ndarray]]:
         """Fixed-shape framework batches, sharded across hosts by index.
 
         Equivalent to DistributedSampler(set_epoch) + DataLoader + collate +
@@ -228,7 +231,8 @@ class LLFFDataset:
         yield from iterate_pair_batches(
             len(self.infos), get_pair, batch_size, shuffle, seed=seed,
             epoch=epoch, drop_last=drop_last, shard_index=shard_index,
-            num_shards=num_shards)
+            num_shards=num_shards, workers=workers,
+            prefetch_batches=prefetch_batches)
 
 
 def get_dataset(config: Dict, logger=None) -> Tuple[LLFFDataset, LLFFDataset]:

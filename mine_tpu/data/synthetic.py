@@ -203,9 +203,11 @@ class SyntheticPairDataset:
         return src, tgt
 
     def batch_iterator(self, batch_size, shuffle, seed=0, epoch=0,
-                       drop_last=True, shard_index=0, num_shards=1):
+                       drop_last=True, shard_index=0, num_shards=1,
+                       workers=0, prefetch_batches=2):
         from mine_tpu.data.common import iterate_pair_batches
         yield from iterate_pair_batches(
             len(self.pairs), self.get_pair, batch_size, shuffle, seed=seed,
             epoch=epoch, drop_last=drop_last, shard_index=shard_index,
-            num_shards=num_shards)
+            num_shards=num_shards, workers=workers,
+            prefetch_batches=prefetch_batches)

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
+from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS, axis_size
 
 
 def _local_composite(rgb, sigma, xyz, z_mask: bool, axis: str):
@@ -41,7 +41,7 @@ def _local_composite(rgb, sigma, xyz, z_mask: bool, axis: str):
     LOCAL shard's [B, S_loc, C, H, W]."""
     B, S_loc, _, H, W = rgb.shape
     idx = jax.lax.axis_index(axis)
-    n_shards = jax.lax.axis_size(axis)
+    n_shards = axis_size(axis)
 
     if z_mask:
         sigma = jnp.where(xyz[:, :, 2:3] >= 0.0, sigma, 0.0)
@@ -100,7 +100,7 @@ def plane_sharded_volume_render(rgb_BS3HW: jnp.ndarray,
     back assertion-free only when S divides the plane axis; callers guard.
     Returns (rgb [B,3,H,W], depth [B,1,H,W]).
     """
-    from jax import shard_map
+    from mine_tpu.parallel.mesh import shard_map
 
     S = rgb_BS3HW.shape[1]
     n_plane = mesh.shape[PLANE_AXIS]
@@ -111,8 +111,7 @@ def plane_sharded_volume_render(rgb_BS3HW: jnp.ndarray,
     vol = P(DATA_AXIS, PLANE_AXIS)
     f = shard_map(body, mesh=mesh,
                   in_specs=(vol, vol, vol),
-                  out_specs=P(DATA_AXIS),
-                  check_vma=False)
+                  out_specs=P(DATA_AXIS))
     out = f(rgb_BS3HW.astype(jnp.float32), sigma_BS1HW.astype(jnp.float32),
             xyz_BS3HW.astype(jnp.float32))
     from mine_tpu.ops.rendering import finalize_depth

@@ -257,15 +257,12 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
             # (the transparency cumprod chains over S — a distributed scan
             # over "plane" is possible but the all-gather of the 7ch volume
             # matches what GSPMD inserts for the XLA composite anyway)
-            from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            from mine_tpu.parallel.mesh import DATA_AXIS
-            # check_vma off: pallas_call outputs carry no mesh-variance info
+            from mine_tpu.parallel.mesh import DATA_AXIS, shard_map
             fn = shard_map(fn, mesh=mesh,
                            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                           out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-                           check_vma=False)
+                           out_specs=(P(DATA_AXIS), P(DATA_AXIS)))
         rgb_syn, depth_syn = fn(tgt_rgb, tgt_sigma, tgt_xyz)
     else:
         tgt_z = tgt_xyz[:, :, 2:3]

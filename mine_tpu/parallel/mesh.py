@@ -82,6 +82,62 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def put_batch(np_batch, mesh: Optional[Mesh]):
+    """Host batch dict -> device arrays under the mesh's INPUT sharding.
+
+    The input-staging primitive (SynthesisTrainer.put_batch and the
+    DeviceStager both land here): per-example arrays are committed with
+    the batch dim sharded over "data", so the jitted step's in_shardings
+    match without a device-side reshard. Without a mesh, a plain
+    device_put (uncommitted default-device placement, like jnp.asarray).
+    Multi-host, each process contributes its local shard
+    (jax.make_array_from_process_local_data).
+
+    `jax.device_put` only ENQUEUES the copy — callers that want the copy
+    off the critical path (the stager's double buffer) block on the
+    result in a background thread, not here.
+    """
+    import jax.numpy as jnp
+    if mesh is None:
+        return {k: jnp.asarray(v) for k, v in np_batch.items()}
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in np_batch.items()}
+    return {k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in np_batch.items()}
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check=False):
+    """Version-portable shard_map for every in-repo call site.
+
+    jax >= 0.7 exports `jax.shard_map` and spells the replication-check
+    flag `check_vma`; the 0.4.x line has it at
+    `jax.experimental.shard_map.shard_map` spelled `check_rep`. The checks
+    stay off either way: the wrapped bodies contain pallas_call outputs,
+    which carry no mesh-variance info for the checker to verify.
+    """
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check)
+
+
+def axis_size(axis_name: str) -> int:
+    """Version-portable static mesh-axis size inside a shard_map body:
+    jax >= 0.6 has jax.lax.axis_size; earlier versions constant-fold
+    psum(1, axis) to the same Python int."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
 def constrain(x, mesh: Optional[Mesh], *spec):
     """with_sharding_constraint that degrades to a no-op without a mesh.
 

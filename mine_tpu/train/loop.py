@@ -11,15 +11,15 @@ checkpoint), same meters and tensorboard tags, but:
 
 from __future__ import annotations
 
-import queue
-import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
+# prefetch is re-exported here for backward compatibility; it moved to the
+# input-pipeline module alongside the threaded assembler + device stager
+from mine_tpu.data.pipeline import DeviceStager, StagedBatch, prefetch  # noqa: F401
 from mine_tpu.train.checkpoint import CheckpointManager
 from mine_tpu.train.state import TrainState, current_lrs
 from mine_tpu.train.step import SynthesisTrainer
@@ -29,53 +29,13 @@ TRAIN_METER_KEYS = ("loss", "loss_rgb_src", "loss_ssim_src",
                     "loss_disp_pt3dsrc", "loss_rgb_tgt", "loss_ssim_tgt",
                     "lpips_tgt", "psnr_tgt", "loss_disp_pt3dtgt")
 
-
-def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
-    """Background-thread prefetch: overlaps host batch assembly/H2D staging
-    with the device step. The reference loads synchronously on the training
-    thread (num_workers=0, train.py:84-87 — flagged in SURVEY.md section 7
-    'known quirks' as worth overlapping).
-
-    Abandoning the generator (consumer raised / broke out) stops the producer
-    promptly instead of leaving a thread blocked on a full queue holding
-    batch memory.
-    """
-    q: "queue.Queue" = queue.Queue(maxsize=depth)
-    stop = threading.Event()
-    _END = object()
-    err = []
-
-    def _put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer():
-        try:
-            for item in iterator:
-                if not _put(item):
-                    return
-        except BaseException as e:  # surface loader errors on the consumer
-            err.append(e)
-        finally:
-            _put(_END)
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _END:
-                if err:
-                    raise err[0]
-                return
-            yield item
-    finally:
-        stop.set()
+# host-side step-time breakdown (milliseconds, averaged per log interval):
+#   step       wall-clock per step
+#   host_wait  blocked waiting for the NEXT staged batch (host-bound time)
+#   device     step minus host_wait (device compute + dispatch backpressure)
+#   h2d        host->device copy of the step's batch, measured in the
+#              stager thread (overlapped with compute unless host-bound)
+TIME_METER_KEYS = ("step_ms", "host_wait_ms", "device_ms", "h2d_ms")
 
 
 class TrainLoop:
@@ -100,6 +60,21 @@ class TrainLoop:
                              for k in TRAIN_METER_KEYS}
         self.val_meters = {k: AverageMeter("val_" + k)
                            for k in TRAIN_METER_KEYS}
+        self.time_meters = {k: AverageMeter("time_" + k, ":.1f")
+                            for k in TIME_METER_KEYS}
+
+        # --- input pipeline knobs (see data/pipeline.py) ---
+        # data.num_workers: assembler threads (0 = synchronous, the
+        # reference's num_workers=0 semantics); batches are identical for
+        # any worker count (counter-based per-item PRNG in data/common.py)
+        self.num_workers = int(self.config.get("data.num_workers", 0) or 0)
+        # bounded host-side queue depth of assembled numpy batches
+        self.prefetch_batches = max(1, int(
+            self.config.get("data.prefetch_batches", 2)))
+        # device-resident staged batches in flight; >=2 overlaps the H2D
+        # copy of batch k+1 with compute of step k, <=1 stages on the
+        # training thread (synchronous, for debugging/A-B)
+        self.staging_buffers = int(self.config.get("data.staging_buffers", 2))
 
         # meters update at log steps only (pulling metrics to host every
         # step would sync the device pipeline); clamp so epochs shorter
@@ -141,6 +116,10 @@ class TrainLoop:
                 self._log("Epoch %d finished, average losses:" % epoch)
                 for m in self.train_meters.values():
                     self._log("    %s" % m)
+                if self.time_meters["step_ms"].count:
+                    self._log("Epoch %d step-time breakdown (ms):" % epoch)
+                    for m in self.time_meters.values():
+                        self._log("    %s" % m)
         # final save: runs shorter than checkpoint_interval otherwise leave
         # NO checkpoint_latest at all — the fixture end-to-end chain dies at
         # eval and a killed short run has nothing to resume from (advisor
@@ -153,44 +132,109 @@ class TrainLoop:
 
     # ---------------- epoch ----------------
 
+    def _epoch_host_batches(self, epoch: int):
+        """Numpy-batch iterator for one epoch: the multi-worker assembler
+        when the loader supports it (all in-repo loaders route
+        batch_iterator through data/common.iterate_pair_batches), else the
+        loader's own iterator behind a single prefetch thread."""
+        kwargs = dict(batch_size=self.local_batch_size,
+                      shuffle=True,
+                      seed=self.seed,
+                      epoch=epoch,
+                      drop_last=True,
+                      shard_index=jax.process_index(),
+                      num_shards=jax.process_count())
+        try:
+            return self.train_dataset.batch_iterator(
+                workers=self.num_workers,
+                prefetch_batches=self.prefetch_batches, **kwargs)
+        except TypeError:  # out-of-tree loader without pipeline kwargs
+            return prefetch(self.train_dataset.batch_iterator(**kwargs),
+                            depth=self.prefetch_batches)
+
+    def _staged_batches(self, host_batches):
+        """StagedBatch iterator: background double-buffered device staging
+        (data/pipeline.DeviceStager), or on-thread staging when
+        data.staging_buffers <= 1 (the synchronous A/B reference)."""
+        if self.staging_buffers >= 2:
+            return iter(DeviceStager(host_batches, self.trainer.put_batch,
+                                     depth=self.staging_buffers))
+
+        def sync():
+            for np_batch in host_batches:
+                t0 = time.perf_counter()
+                batch = self.trainer.put_batch(np_batch)
+                jax.block_until_ready(batch)
+                yield StagedBatch(batch, (time.perf_counter() - t0) * 1e3)
+        return sync()
+
     def train_epoch(self, state: TrainState, epoch: int) -> TrainState:
         for m in self.train_meters.values():
             m.reset()
+        for m in self.time_meters.values():
+            m.reset()
 
-        it = self.train_dataset.batch_iterator(
-            batch_size=self.local_batch_size,
-            shuffle=True,
-            seed=self.seed,
-            epoch=epoch,
-            drop_last=True,
-            shard_index=jax.process_index(),
-            num_shards=jax.process_count())
+        staged = self._staged_batches(self._epoch_host_batches(epoch))
 
+        # gstep is tracked on the HOST (the jitted step increments
+        # state.step by exactly 1): reading int(state.step) every
+        # iteration would block on the step's completion and serialize
+        # device compute with the host feed — the pre-pipeline loop paid
+        # that sync each step.
+        gstep = int(state.step)
         step_in_epoch = 0
         t_last = time.perf_counter()
-        for np_batch in prefetch(it):
-            batch = self.trainer.put_batch(np_batch)
-            state, metrics = self.trainer.train_step(state, batch)
+        host_wait_s = 0.0
+        h2d_ms_acc = 0.0
+        steps_since_log = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                sb = next(staged)
+            except StopIteration:
+                break
+            host_wait_s += time.perf_counter() - t0
+            h2d_ms_acc += sb.h2d_ms
+            state, metrics = self.trainer.train_step(state, sb.batch)
             step_in_epoch += 1
-            gstep = int(state.step)
+            gstep += 1
+            steps_since_log += 1
 
             if step_in_epoch % self.log_interval == 0 and self.is_lead:
-                m = metrics_to_float(metrics)
-                dt = (time.perf_counter() - t_last) / self.log_interval
+                m = metrics_to_float(metrics)  # device sync, log steps only
+                dt = (time.perf_counter() - t_last) / steps_since_log
+                times = {
+                    "step_ms": dt * 1e3,
+                    "host_wait_ms": host_wait_s / steps_since_log * 1e3,
+                    "h2d_ms": h2d_ms_acc / steps_since_log,
+                }
+                times["device_ms"] = max(
+                    0.0, times["step_ms"] - times["host_wait_ms"])
+                self._log_training(epoch, step_in_epoch, gstep, m, times)
                 t_last = time.perf_counter()
-                self._log_training(epoch, step_in_epoch, gstep, m, dt)
+                host_wait_s = h2d_ms_acc = 0.0
+                steps_since_log = 0
 
             # checkpoint saves and eval are collective over the mesh: EVERY
             # process participates (orbax + jit would deadlock otherwise);
             # only logging/TB writes are lead-gated.
+            did_pause = False
             if gstep > 0 and gstep % self.ckpt_interval == 0:
                 self.ckpt.save_latest(state)
                 self._log("Latest checkpoint saved at step %d" % gstep)
+                did_pause = True
 
             if gstep > 0 and (gstep == 2000 or gstep % self.eval_interval == 0) \
                     and self.val_dataset is not None:
                 self.run_eval(state)
                 self.ckpt.save_step(state)
+                did_pause = True
+            if did_pause:
+                # don't charge checkpoint/eval wall-time to the step
+                # breakdown of the next log interval
+                t_last = time.perf_counter()
+                host_wait_s = h2d_ms_acc = 0.0
+                steps_since_log = 0
         return state
 
     # ---------------- eval ----------------
@@ -291,17 +335,27 @@ class TrainLoop:
         if self.logger is not None and self.is_lead:
             self.logger.info(msg, *args)
 
-    def _log_training(self, epoch, step, gstep, m, step_time):
+    def _log_training(self, epoch, step, gstep, m, times):
         lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
         self._log(
             "epoch [%.3d] step [%d] global_step = %d total_loss = %.4f "
             "encoder_lr = %.7f step_time = %.3fs\n"
             "        src: rgb = %.4f ssim = %.4f disp_pt3d = %.4f\n"
-            "        tgt: rgb = %.4f ssim = %.4f disp_pt3d = %.4f psnr = %.2f"
-            % (epoch, step, gstep, m["loss"], lrs["backbone"], step_time,
+            "        tgt: rgb = %.4f ssim = %.4f disp_pt3d = %.4f psnr = %.2f\n"
+            # parseable pipeline breakdown (tools/step_breakdown.py)
+            "        time: step = %.1f ms host_wait = %.1f ms "
+            "device = %.1f ms h2d = %.1f ms"
+            % (epoch, step, gstep, m["loss"], lrs["backbone"],
+               times["step_ms"] / 1e3,
                m["loss_rgb_src"], m["loss_ssim_src"], m["loss_disp_pt3dsrc"],
                m["loss_rgb_tgt"], m["loss_ssim_tgt"], m["loss_disp_pt3dtgt"],
-               m["psnr_tgt"]))
+               m["psnr_tgt"],
+               times["step_ms"], times["host_wait_ms"], times["device_ms"],
+               times["h2d_ms"]))
+        for k, meter in self.time_meters.items():
+            meter.update(times[k])
+            if self.tb is not None:
+                self.tb.add_scalar("time/" + k, times[k], gstep)
         # diagnostics beyond the fixed reference meter set (e.g.
         # warp_fallback_frac from the guarded warp backends) get meters on
         # first sight so they reach the epoch summaries and TB too

@@ -200,8 +200,12 @@ def loss_per_scale(scale: int,
 
     # src-view photometrics: logged, no gradient (synthesis_task.py:301-306)
     loss_rgb_src = jax.lax.stop_gradient(agg(pex(jnp.abs(src_syn - src_imgs))))
+    ssim_prec = cfg.ssim_precision  # "highest" -> Precision.HIGHEST in ssim()
+    if ssim_prec == "highest":
+        ssim_prec = None
     loss_ssim_src = jax.lax.stop_gradient(
-        agg(1.0 - ssim(src_syn, src_imgs, size_average=False)))
+        agg(1.0 - ssim(src_syn, src_imgs, size_average=False,
+                       precision=ssim_prec)))
     loss_smooth_src = jax.lax.stop_gradient(
         agg(edge_aware_loss(src_imgs, src_disp_syn,
                             gmin=cfg.smoothness_gmin,
@@ -224,7 +228,8 @@ def loss_per_scale(scale: int,
     # tgt rgb, masked to pixels covered by enough warped planes (:324-328)
     valid = (tgt_mask >= cfg.valid_mask_threshold).astype(jnp.float32)
     loss_rgb_tgt = agg(pex(jnp.abs(tgt_syn - tgt_imgs) * valid))
-    loss_ssim_tgt = agg(1.0 - ssim(tgt_syn, tgt_imgs, size_average=False))
+    loss_ssim_tgt = agg(1.0 - ssim(tgt_syn, tgt_imgs, size_average=False,
+                                   precision=ssim_prec))
 
     if cfg.smoothness_lambda_v1 != 0.0:
         loss_smooth_tgt = cfg.smoothness_lambda_v1 * agg(edge_aware_loss(

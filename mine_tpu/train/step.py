@@ -140,13 +140,22 @@ class SynthesisTrainer:
         # codegen effort drops, ~2.3x faster compiles). None for training.
         jit = functools.partial(jax.jit, compiler_options=compiler_options) \
             if compiler_options else jax.jit
+        # training.donate_batch: also donate the BATCH buffers to the train
+        # step, so XLA reuses the staged input memory instead of holding
+        # both the live batch and the step's workspace. Valid only when
+        # every step gets a freshly staged batch (the async input pipeline,
+        # train/loop.py + data/pipeline.py); callers that re-feed one
+        # resident batch (bench.py's device-step variants, overfit tests)
+        # must leave it off or the second call hits deleted buffers.
+        donate_train = (0, 1) if bool(
+            config.get("training.donate_batch", False)) else (0,)
         if mesh is not None:
             batch_s = mesh_lib.batch_sharding(mesh)
             repl = mesh_lib.replicated(mesh)
             self._train_step = jit(self._train_step_impl,
                                    in_shardings=(repl, batch_s),
                                    out_shardings=(repl, repl),
-                                   donate_argnums=0)
+                                   donate_argnums=donate_train)
             self._eval_step = jit(self._eval_step_impl,
                                   in_shardings=(repl, batch_s, repl),
                                   out_shardings=repl)
@@ -159,7 +168,8 @@ class SynthesisTrainer:
                 in_shardings=(repl, batch_s, repl, batch_s),
                 out_shardings=repl)
         else:
-            self._train_step = jit(self._train_step_impl, donate_argnums=0)
+            self._train_step = jit(self._train_step_impl,
+                                   donate_argnums=donate_train)
             self._eval_step = jit(self._eval_step_impl)
             self._eval_step_masked = jit(self._eval_step_masked_impl)
 
@@ -179,12 +189,12 @@ class SynthesisTrainer:
         return self.global_batch_size() // jax.process_count()
 
     def put_batch(self, np_batch):
-        """Host batch -> (possibly multi-host global) device batch."""
-        if self.mesh is None or jax.process_count() == 1:
-            return {k: jnp.asarray(v) for k, v in np_batch.items()}
-        sharding = mesh_lib.batch_sharding(self.mesh)
-        return {k: jax.make_array_from_process_local_data(sharding, v)
-                for k, v in np_batch.items()}
+        """Host batch -> (possibly multi-host global) device batch, committed
+        under the mesh's input sharding (parallel/mesh.put_batch) so the
+        jitted step consumes it without a reshard. Called by the train
+        loop's DeviceStager from a background thread — keep it free of
+        trainer state mutation."""
+        return mesh_lib.put_batch(np_batch, self.mesh)
 
     # ---------------- state ----------------
 

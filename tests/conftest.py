@@ -56,6 +56,7 @@ QUICK = {
     "test_mesh.py::test_num_slices",
     "test_models.py::test_positional_encoding_matches_reference_formula",
     "test_native_io.py::test_decode_resize_matches_pil",
+    "test_pipeline.py::test_assembler_matches_sequential",
     "test_plane_scan.py::test_single_plane_shard_degenerates_to_serial",
     "test_realestate10k.py::test_parse_camera_file",
     "test_rendering.py::test_alpha_composition_two_planes",
@@ -84,6 +85,7 @@ MEDIUM_FILES = {
     "test_plane_scan.py",
     "test_train.py",
     "test_train_loop.py",
+    "test_pipeline.py",
     "test_checkpoint.py",
     "test_loss_aggregation.py",
     "test_packed_decoder.py",

@@ -1,0 +1,266 @@
+"""Async input pipeline (data/pipeline.py + the loop's staged feed).
+
+The pipeline's correctness contract is DETERMINISM: batch assembly is
+counter-based (data/common.item_rng), so the multi-worker assembler must
+yield bitwise-identical batches to the synchronous loop for any worker
+count, and an interrupted+resumed consumer must see batch k unchanged.
+The loop-level tests share ONE tiny trainer (module fixture) so the suite
+pays a single train-step compile.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mine_tpu.data import common
+from mine_tpu.data.common import iterate_pair_batches
+from mine_tpu.data.pipeline import DeviceStager, StagedBatch, prefetch
+
+
+def _make_get_pair(num_items=23, fail_at=None, calls=None):
+    """Fake loader honoring the collate contract; rng-dependent values so
+    per-item PRNG misrouting shows up as a value diff, not just order."""
+    def get_pair(index, rng=None):
+        if calls is not None:
+            calls.append(index)
+        if fail_at is not None and index == fail_at:
+            raise ValueError("boom at %d" % index)
+        jitter = rng.uniform() if rng is not None else 0.0
+        img = np.full((4, 4, 3), index + jitter, np.float32)
+        side = {"img": img, "K": np.eye(3, dtype=np.float32),
+                "xyzs": np.full((3, 5), index, np.float32)}
+        tgt = dict(side)
+        tgt["G_src_tgt"] = np.eye(4, dtype=np.float32)
+        return side, tgt
+    return get_pair
+
+
+def _collect(**kw):
+    kw.setdefault("num_items", 23)
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("seed", 3)
+    kw.setdefault("epoch", 2)
+    get_pair = kw.pop("get_pair", None) or _make_get_pair(kw["num_items"])
+    return list(iterate_pair_batches(kw.pop("num_items"), get_pair, **kw))
+
+
+def test_item_rng_is_counter_based():
+    a = common.item_rng(1, 2, 3).uniform(size=4)
+    b = common.item_rng(1, 2, 3).uniform(size=4)
+    np.testing.assert_array_equal(a, b)
+    # any key component moves the stream
+    for other in [(0, 2, 3), (1, 0, 3), (1, 2, 4)]:
+        assert not np.array_equal(a, common.item_rng(*other).uniform(size=4))
+
+
+def test_assembler_matches_sequential():
+    """N workers, any N, must reproduce the synchronous sequence bitwise —
+    the property that makes checkpoint resume independent of the pipeline."""
+    ref = _collect(workers=0)
+    assert len(ref) == 5  # 23 items, batch 4, drop_last
+    for workers in (1, 2, 5):
+        got = _collect(workers=workers, prefetch_batches=2)
+        assert len(got) == len(ref)
+        for rb, gb in zip(ref, got):
+            assert sorted(rb) == sorted(gb)
+            for k in rb:
+                np.testing.assert_array_equal(rb[k], gb[k])
+
+
+def test_assembler_worker_error_propagates():
+    get_pair = _make_get_pair(num_items=23, fail_at=11)
+    with pytest.raises(ValueError, match="boom at 11"):
+        _collect(get_pair=get_pair, shuffle=False, workers=3)
+    # synchronous path raises the same error for the same data
+    with pytest.raises(ValueError, match="boom at 11"):
+        _collect(get_pair=get_pair, shuffle=False, workers=0)
+
+
+def test_assembler_shutdown_on_abandon():
+    """Breaking out of the consumer must stop the worker pool (no leaked
+    threads blocked on a full queue holding batch memory)."""
+    def alive():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("mine-tpu-assembler")]
+
+    it = iterate_pair_batches(40, _make_get_pair(40), 4, True,
+                              seed=0, epoch=0, workers=3)
+    next(it)
+    assert alive()
+    it.close()
+    deadline = time.time() + 5.0
+    while alive() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not alive()
+
+
+def test_assembler_bounded_inflight():
+    """At most max(workers, prefetch_batches) batches may be assembled
+    ahead of the consumer (the credit semaphore's bound)."""
+    calls = []
+    it = iterate_pair_batches(64, _make_get_pair(64, calls=calls), 4, False,
+                              seed=0, epoch=0, workers=2, prefetch_batches=3)
+    next(it)
+    time.sleep(0.3)  # give the pool time to run ahead if it were unbounded
+    # consumed 1 batch -> at most (1 + bound) * batch_size items touched
+    assert len(calls) <= (1 + 3) * 4
+    it.close()
+
+
+def test_exact_resume_mid_queue():
+    """Kill the consumer mid-queue, rebuild the iterator (as a restored
+    run does), skip k batches: batch k is bitwise what the uninterrupted
+    sequence had — prefetched-but-unconsumed batches are not lost."""
+    ref = _collect(workers=0)
+    k = 2
+    first = iterate_pair_batches(23, _make_get_pair(23), 4, True,
+                                 seed=3, epoch=2, workers=3)
+    for _ in range(k):
+        next(first)
+    first.close()  # abandon with batches still queued
+
+    resumed = iterate_pair_batches(23, _make_get_pair(23), 4, True,
+                                   seed=3, epoch=2, workers=3)
+    for _ in range(k):
+        next(resumed)
+    batch_k = next(resumed)
+    for key in ref[k]:
+        np.testing.assert_array_equal(ref[k][key], batch_k[key])
+    resumed.close()
+
+
+def test_device_stager_order_values_and_timing():
+    import jax.numpy as jnp
+
+    host = [{"x": np.full((2, 2), i, np.float32)} for i in range(6)]
+    put = lambda b: {k: jnp.asarray(v) for k, v in b.items()}  # noqa: E731
+    out = list(DeviceStager(iter(host), put, depth=2))
+    assert len(out) == 6
+    for i, sb in enumerate(out):
+        assert isinstance(sb, StagedBatch)
+        assert sb.h2d_ms >= 0.0
+        np.testing.assert_array_equal(np.asarray(sb.batch["x"]), host[i]["x"])
+
+
+def test_device_stager_propagates_put_errors():
+    def bad_put(b):
+        raise RuntimeError("transfer failed")
+    with pytest.raises(RuntimeError, match="transfer failed"):
+        list(DeviceStager(iter([{"x": np.zeros(2)}]), bad_put, depth=2))
+
+
+def test_prefetch_reexport_from_loop():
+    """loop.prefetch moved to data/pipeline.py; the re-export must keep the
+    old import path working."""
+    from mine_tpu.train import loop as loop_mod
+    assert loop_mod.prefetch is prefetch
+    assert list(loop_mod.prefetch(iter(range(5)))) == list(range(5))
+
+
+# --------------------------------------------------------------------------
+# loop-level: ONE shared tiny trainer (single train-step compile) drives the
+# sync-vs-staged A/B and the breakdown-log test
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_loop_setup(tmp_path_factory):
+    from mine_tpu.data.synthetic import SyntheticPairDataset
+    from mine_tpu.train.loop import TrainLoop
+    from mine_tpu.train.step import SynthesisTrainer
+    from tests.test_train import tiny_config
+
+    cfg = tiny_config(**{
+        "data.img_h": 32, "data.img_w": 32,
+        # donation on for BOTH feed paths: every batch is staged fresh, so
+        # this also exercises donate_batch under the pipeline
+        "training.donate_batch": True,
+        "data.num_workers": 2,
+        "training.log_interval": 1,
+    })
+    data = SyntheticPairDataset(num_views=5, num_points=16,
+                                height=32, width=32, seed=0)
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=len(data))
+    ws = str(tmp_path_factory.mktemp("pipeline_ws"))
+    loop = TrainLoop(trainer, data, None, ws, logger=None, tb_writer=None)
+    return trainer, loop
+
+
+def _epoch_losses(trainer, loop, staged: bool):
+    """Run one epoch; return the per-step loss sequence as float64."""
+    from mine_tpu.utils import metrics_to_float
+
+    loop.num_workers = 2 if staged else 0
+    loop.staging_buffers = 2 if staged else 0
+    recorded = []
+    orig = trainer.train_step
+
+    def recording_step(state, batch):
+        state, metrics = orig(state, batch)
+        recorded.append(metrics)
+        return state, metrics
+
+    trainer.train_step = recording_step
+    try:
+        state = trainer.init_state(batch_size=1, seed=0)
+        loop.train_epoch(state, epoch=1)
+    finally:
+        trainer.train_step = orig
+    return [metrics_to_float(m)["loss"] for m in recorded]
+
+
+def test_staged_vs_sync_loss_sequences_identical(tiny_loop_setup):
+    """The A/B the tentpole must win on semantics before speed: async
+    assembly + double-buffered staging may not change a single loss."""
+    trainer, loop = tiny_loop_setup
+    sync_losses = _epoch_losses(trainer, loop, staged=False)
+    staged_losses = _epoch_losses(trainer, loop, staged=True)
+    assert len(sync_losses) == 4  # 4 pairs, batch 1
+    assert sync_losses == staged_losses
+    assert all(np.isfinite(v) for v in sync_losses)
+
+
+class _ListLogger:
+    def __init__(self):
+        self.lines = []
+
+    def info(self, msg, *args):
+        self.lines.append(msg % args if args else str(msg))
+
+
+def test_loop_logs_parseable_breakdown(tiny_loop_setup):
+    """Every log interval must carry the host_wait/device/h2d split, in the
+    exact format tools/step_breakdown.py parses."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import step_breakdown
+    finally:
+        sys.path.pop(0)
+
+    trainer, loop = tiny_loop_setup
+    loop.num_workers = 2
+    loop.staging_buffers = 2
+    logger = _ListLogger()
+    loop.logger = logger
+    try:
+        state = trainer.init_state(batch_size=1, seed=0)
+        loop.train_epoch(state, epoch=1)
+    finally:
+        loop.logger = None
+
+    samples = step_breakdown.parse_lines(logger.lines)
+    assert len(samples["step"]) == 4  # log_interval=1, 4 steps
+    for k in ("step", "host_wait", "device", "h2d"):
+        assert all(v >= 0.0 for v in samples[k]), k
+    # the loop's invariant: device = step - host_wait (clamped at 0)
+    for s, hw, dv in zip(samples["step"], samples["host_wait"],
+                         samples["device"]):
+        np.testing.assert_allclose(dv, max(0.0, s - hw), atol=0.1)
+    # meters carry the same averages for the epoch summary
+    assert loop.time_meters["step_ms"].count == 4
+    summary = step_breakdown.summarize(samples)
+    assert "host-bound fraction" in summary

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Summarize the train loop's per-step time breakdown from a training log.
+
+The loop prints, at every log interval (train/loop.py _log_training):
+
+    time: step = 812.0 ms host_wait = 590.1 ms device = 221.9 ms h2d = 35.2 ms
+
+This tool aggregates those lines into count/mean/p50/p90 per component and
+reports the host-bound fraction — the share of wall-clock the chip spent
+waiting on the input pipeline. Use it to decide which pipeline knob to turn:
+high host_wait with low h2d means assembly-bound (raise data.num_workers);
+host_wait tracking h2d means copy-bound (raise data.staging_buffers).
+
+Usage: python tools/step_breakdown.py LOGFILE [LOGFILE ...]
+       ... | python tools/step_breakdown.py -
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+LINE_RE = re.compile(
+    r"time: step = ([0-9.]+) ms host_wait = ([0-9.]+) ms "
+    r"device = ([0-9.]+) ms h2d = ([0-9.]+) ms")
+
+KEYS = ("step", "host_wait", "device", "h2d")
+
+
+def parse_lines(lines):
+    """-> dict of key -> list of ms samples, one entry per breakdown line."""
+    samples = {k: [] for k in KEYS}
+    for line in lines:
+        m = LINE_RE.search(line)
+        if not m:
+            continue
+        for k, v in zip(KEYS, m.groups()):
+            samples[k].append(float(v))
+    return samples
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def summarize(samples) -> str:
+    n = len(samples["step"])
+    if n == 0:
+        return "no 'time: step = ...' breakdown lines found"
+    out = ["step-time breakdown over %d log intervals (ms):" % n,
+           "  %-10s %10s %10s %10s" % ("component", "mean", "p50", "p90")]
+    for k in KEYS:
+        vals = sorted(samples[k])
+        out.append("  %-10s %10.1f %10.1f %10.1f"
+                   % (k, sum(vals) / n, _pct(vals, 0.5), _pct(vals, 0.9)))
+    host_frac = sum(samples["host_wait"]) / max(sum(samples["step"]), 1e-9)
+    out.append("  host-bound fraction (host_wait/step): %.1f%%"
+               % (100.0 * host_frac))
+    if host_frac > 0.2:
+        h2d_frac = sum(samples["h2d"]) / max(sum(samples["host_wait"]), 1e-9)
+        out.append("  hint: %s" % (
+            "copy-bound — raise data.staging_buffers" if h2d_frac > 0.5
+            else "assembly-bound — raise data.num_workers / "
+                 "data.prefetch_batches"))
+    return "\n".join(out)
+
+
+def main(argv):
+    paths = argv[1:] or ["-"]
+    lines = []
+    for p in paths:
+        if p == "-":
+            lines.extend(sys.stdin.readlines())
+        else:
+            with open(p) as f:
+                lines.extend(f.readlines())
+    print(summarize(parse_lines(lines)))
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -159,6 +159,12 @@ VARIANTS = {
     # designed fix. Kept LAST in sweep order: if it still thrashes, the
     # headline numbers are already on disk.
     "b8_chunk4": (8, {"training.decoder_plane_chunks": 4}),
+    # LOSS-GRAPH-ONLY row (not a train-step variant): times value_and_grad
+    # of compute_losses over frozen decoder outputs — the "73 ms elementwise
+    # tail" region the PR-2 fused-pyramid pass restructures. Measurable
+    # without a full soak; compare against the pre-fusion row in
+    # BENCH_NOTES to price the shared-pyramid/batched-SSIM win on chip.
+    "losspass_b4": (4, {}),
     # END-TO-END pipeline-fed loop (not a resident-batch device-step
     # variant): threaded batch assembly + double-buffered device staging
     # feeding the jitted step, fresh batch every step with the input
@@ -272,6 +278,67 @@ def _measure_realloop(name, steps=MEASURE_STEPS, keep_run=False):
         batch_size
 
 
+def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False):
+    """Loss-graph-only measurement (the losspass_* variants).
+
+    The model forward runs ONCE outside the timed region (exactly the key
+    derivation _grads_and_metrics uses); the timed executable is
+    value_and_grad of compute_losses with respect to the four mpi pyramids —
+    the 4-scale render + photometric/SSIM/smoothness graph in isolation.
+    This is the region the fused-pyramid pass restructures, so its ms/step
+    is readable here without soaking a full train step. Steps don't chain
+    through state, but the device queue serializes identical dispatches, so
+    fetching the last step's loss still bounds all n executions."""
+    import jax
+
+    from mine_tpu.train import loss as loss_mod
+    from mine_tpu.train.step import sample_disparity
+
+    trainer, state, batch = build_variant_program(name)
+    batch_size = int(batch["src_img"].shape[0])
+
+    key = jax.random.fold_in(state.rng, state.step)
+    d_key, f_key, drop_key = jax.random.split(key, 3)
+    disparity = sample_disparity(d_key, batch_size, trainer.cfg)
+    mpi_list, disparity_all, _ = trainer._forward(
+        state.params, state.batch_stats, batch, disparity, f_key, drop_key,
+        train=True)
+    mpi_list = jax.block_until_ready(list(mpi_list))
+
+    cfg, mesh = trainer.cfg, trainer.mesh
+
+    def loss_only(mpis, disp, bt):
+        total, metrics, _ = loss_mod.compute_losses(mpis, disp, bt, cfg,
+                                                    mesh=mesh)
+        return total, metrics
+
+    lowered = jax.jit(jax.value_and_grad(loss_only, has_aux=True)).lower(
+        mpi_list, disparity_all, batch)
+    tflops = None
+    try:
+        tflops = lowered.cost_analysis().get("flops", 0.0) / 1e12 or None
+    except Exception:
+        pass
+    loss_fn = lowered.compile()
+
+    for _ in range(WARMUP_STEPS):
+        (total, _), _grads = loss_fn(mpi_list, disparity_all, batch)
+    jax.block_until_ready(total)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            (total, _), _grads = loss_fn(mpi_list, disparity_all, batch)
+        float(jax.device_get(total))
+        return time.perf_counter() - t0
+
+    dt = run(steps)
+    print("  losspass: %d loss fwd+bwd in %.3fs (%.1f ms/step, loss graph "
+          "only)" % (steps, dt, 1e3 * dt / steps), file=sys.stderr)
+    return batch_size * steps / dt, tflops, (run if keep_run else None), \
+        batch_size
+
+
 def _measure(name, steps=MEASURE_STEPS, keep_run=False):
     """Compile + run one variant.
 
@@ -282,6 +349,8 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
 
     if name.startswith("realloop"):
         return _measure_realloop(name, steps=steps, keep_run=keep_run)
+    if name.startswith("losspass"):
+        return _measure_losspass(name, steps=steps, keep_run=keep_run)
 
     trainer, state, batch = build_variant_program(name)
     batch_size = int(batch["src_img"].shape[0])

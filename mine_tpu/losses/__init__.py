@@ -1,3 +1,4 @@
-from mine_tpu.losses.photometric import (edge_aware_loss, edge_aware_loss_v2,  # noqa: F401
-                                         psnr)
-from mine_tpu.losses.ssim import ssim  # noqa: F401
+from mine_tpu.losses.photometric import (edge_aware_image_masks,  # noqa: F401
+                                         edge_aware_loss, edge_aware_loss_v2,
+                                         image_mean_abs_grads, psnr)
+from mine_tpu.losses.ssim import resolve_precision, ssim, ssim_pairs  # noqa: F401

@@ -60,17 +60,14 @@ def psnr(img1: jnp.ndarray, img2: jnp.ndarray,
     return jnp.mean(per_image) if size_average else per_image
 
 
-def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
-                    gmin: float, grad_ratio: float,
-                    size_average: bool = True) -> jnp.ndarray:
-    """Edge-masked hinge smoothness on instance-normalized disparity
-    gradients (network/layers.py:54-80).
+def edge_aware_image_masks(img: jnp.ndarray, grad_ratio: float):
+    """The image-only half of edge_aware_loss: per-image sobel edge masks
+    (normalized by each image's own max gradient and grad_ratio, clamped at
+    1). Depends on nothing but the image, so the training loss computes it
+    once per pyramid scale and shares it across the src-logging and tgt
+    smoothness terms instead of re-running the sobel conv per call site.
 
-    Image gradients build a per-image edge mask (normalized by the image's own
-    max gradient and grad_ratio, clamped at 1); disparity gradients are
-    instance-normalized, hinged at gmin, and penalized away from edges.
-
-    Args: img [B,3,H,W]; disp [B,1,H,W]
+    Args: img [B,3,H,W]. Returns (edge_mask_x, edge_mask_y), each [B,1,H,W].
     """
     grad_img = jnp.sum(jnp.abs(sobel_gradients(img, normalized=True)),
                        axis=1, keepdims=True)  # [B,1,2,H,W]
@@ -81,6 +78,27 @@ def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
 
     edge_mask_x = jnp.minimum(grad_img_x / (gmax_x * grad_ratio), 1.0)
     edge_mask_y = jnp.minimum(grad_img_y / (gmax_y * grad_ratio), 1.0)
+    return edge_mask_x, edge_mask_y
+
+
+def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
+                    gmin: float, grad_ratio: float,
+                    size_average: bool = True,
+                    edge_masks=None) -> jnp.ndarray:
+    """Edge-masked hinge smoothness on instance-normalized disparity
+    gradients (network/layers.py:54-80).
+
+    Image gradients build a per-image edge mask (normalized by the image's own
+    max gradient and grad_ratio, clamped at 1); disparity gradients are
+    instance-normalized, hinged at gmin, and penalized away from edges.
+
+    Args: img [B,3,H,W]; disp [B,1,H,W]; edge_masks optionally carries a
+    precomputed `edge_aware_image_masks(img, grad_ratio)` result (callers
+    evaluating several disparities against one image amortize the sobel).
+    """
+    if edge_masks is None:
+        edge_masks = edge_aware_image_masks(img, grad_ratio)
+    edge_mask_x, edge_mask_y = edge_masks
 
     grad_disp = jnp.abs(sobel_gradients(disp, normalized=False))
     grad_disp_x = _instance_norm(grad_disp[:, :, 0]) - gmin
@@ -93,12 +111,28 @@ def edge_aware_loss(img: jnp.ndarray, disp: jnp.ndarray,
     return jnp.mean(loss_x + loss_y, axis=(1, 2, 3))
 
 
+def image_mean_abs_grads(img: jnp.ndarray):
+    """The image-only half of edge_aware_loss_v2: channel-mean |finite-diff|
+    gradients. Precomputable per pyramid scale and shared across the src/tgt
+    v2 smoothness terms.
+
+    Args: img [B,3,H,W]. Returns (grad_i_x [B,1,H,W-1], grad_i_y [B,1,H-1,W]).
+    """
+    grad_i_x = jnp.mean(jnp.abs(img[:, :, :, :-1] - img[:, :, :, 1:]),
+                        axis=1, keepdims=True)
+    grad_i_y = jnp.mean(jnp.abs(img[:, :, :-1, :] - img[:, :, 1:, :]),
+                        axis=1, keepdims=True)
+    return grad_i_x, grad_i_y
+
+
 def edge_aware_loss_v2(img: jnp.ndarray, disp: jnp.ndarray,
-                       size_average: bool = True) -> jnp.ndarray:
+                       size_average: bool = True,
+                       img_grads=None) -> jnp.ndarray:
     """Classic monodepth2 edge-aware smoothness on mean-normalized disparity
     (network/layers.py:83-99).
 
-    Args: img [B,3,H,W]; disp [B,1,H,W]
+    Args: img [B,3,H,W]; disp [B,1,H,W]; img_grads optionally carries a
+    precomputed `image_mean_abs_grads(img)` result.
     """
     mean_disp = jnp.mean(disp, axis=(2, 3), keepdims=True)
     d = disp / (mean_disp + 1e-7)
@@ -106,10 +140,9 @@ def edge_aware_loss_v2(img: jnp.ndarray, disp: jnp.ndarray,
     grad_d_x = jnp.abs(d[:, :, :, :-1] - d[:, :, :, 1:])
     grad_d_y = jnp.abs(d[:, :, :-1, :] - d[:, :, 1:, :])
 
-    grad_i_x = jnp.mean(jnp.abs(img[:, :, :, :-1] - img[:, :, :, 1:]),
-                        axis=1, keepdims=True)
-    grad_i_y = jnp.mean(jnp.abs(img[:, :, :-1, :] - img[:, :, 1:, :]),
-                        axis=1, keepdims=True)
+    if img_grads is None:
+        img_grads = image_mean_abs_grads(img)
+    grad_i_x, grad_i_y = img_grads
 
     grad_d_x = grad_d_x * jnp.exp(-grad_i_x)
     grad_d_y = grad_d_y * jnp.exp(-grad_i_y)

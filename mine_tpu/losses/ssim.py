@@ -15,6 +15,15 @@ semantics to the reference conv's zero padding. The contraction runs on
 the MXU at full lane width regardless of C, and autodiff's transpose of an
 einsum is the same-shaped einsum, so the backward inherits the layout for
 free. Measured on v5e (BENCH_NOTES_r05.md): 57.2 -> ~2 ms/step.
+
+Dispatch fusion (the PR-2 pass): one SSIM evaluation needs 5 blurred
+fields (x, y, x², y², xy) and the training loss evaluates TWO image pairs
+per pyramid scale (src and tgt) — as independent `ssim()` calls that was
+5 blurs x 2 einsums x 2 pairs = 20 MXU dispatches per scale, 80 per step.
+`ssim_pairs` stacks every blur operand of every pair along the batch axis
+of ONE Toeplitz pass, so a scale costs exactly 2 einsums (8 per step); the
+batch axis of the einsum is elementwise-independent, so each image's blur
+is bit-identical to its standalone call.
 """
 
 from __future__ import annotations
@@ -24,6 +33,32 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def resolve_precision(precision):
+    """The ONE `training.ssim_precision` -> einsum-precision translation.
+
+    "highest" / None -> Precision.HIGHEST: full-f32 MXU passes, matching the
+    reference conv2d bit-for-bit on CPU and to f32 rounding on TPU (the
+    shipped default). "default" -> None: the platform picks (bf16 operand
+    splitting on TPU — ~2e-3 blur / ~3e-3 SSIM shift; with the Toeplitz
+    form both settings measure ~2 ms/step on v5e, BENCH_NOTES_r05.md).
+    A `jax.lax.Precision` passes through untouched.
+
+    History note: this used to be TWO stacked maps (train/loss.py sent
+    "highest"->None, `_blur` sent None->HIGHEST and "default"->None) — a
+    double negation one refactor away from silently flipping the default.
+    Every entry point now funnels through this helper instead.
+    """
+    if isinstance(precision, jax.lax.Precision):
+        return precision
+    if precision in (None, "highest"):
+        return jax.lax.Precision.HIGHEST
+    if precision == "default":
+        return None
+    raise ValueError(
+        f"ssim precision must be 'highest', 'default', None, or a "
+        f"jax.lax.Precision, got {precision!r}")
 
 
 @functools.lru_cache(maxsize=None)
@@ -50,21 +85,9 @@ def _blur_matrix(n: int, window_size: int, sigma: float) -> np.ndarray:
 
 
 def _blur(x_nhwc: jnp.ndarray, window_size: int, sigma: float,
-          precision=None) -> jnp.ndarray:
+          precision) -> jnp.ndarray:
     """Separable gaussian blur of [B, H, W, C] via two Toeplitz matmuls.
-
-    precision defaults to Precision.HIGHEST: full-f32 MXU passes, matching
-    the reference conv2d bit-for-bit on CPU and to f32 rounding on TPU.
-    precision=None-as-passed ("default") lets the platform split operands
-    into bf16 passes — on v5e that shifted the blur by ~2e-3 and the final
-    SSIM by ~3e-3 while cutting the step's SSIM terms from 57 ms to ~2 ms
-    pre-Toeplitz; with the Toeplitz form both run ~2 ms, so HIGHEST is the
-    shipped default and "default" stays as the training.ssim_precision
-    escape hatch."""
-    if precision is None:
-        precision = jax.lax.Precision.HIGHEST
-    elif precision == "default":
-        precision = None
+    `precision` must already be resolved (see resolve_precision)."""
     H, W = x_nhwc.shape[1], x_nhwc.shape[2]
     Mh = jnp.asarray(_blur_matrix(H, window_size, sigma))
     Mw = jnp.asarray(_blur_matrix(W, window_size, sigma))
@@ -76,32 +99,58 @@ def _blur(x_nhwc: jnp.ndarray, window_size: int, sigma: float,
                       precision=precision)
 
 
-def ssim(img1: jnp.ndarray, img2: jnp.ndarray,
-         window_size: int = 11, sigma: float = 1.5,
-         size_average: bool = True, precision=None) -> jnp.ndarray:
-    """SSIM between [B, C, H, W] images. Returns a scalar (size_average) or
-    per-image [B] means. `precision` feeds the blur einsums: None ->
-    Precision.HIGHEST, "default" -> platform default (see _blur)."""
-    x = jnp.transpose(img1, (0, 2, 3, 1)).astype(jnp.float32)
-    y = jnp.transpose(img2, (0, 2, 3, 1)).astype(jnp.float32)
+def ssim_pairs(img1s: jnp.ndarray, img2s: jnp.ndarray,
+               window_size: int = 11, sigma: float = 1.5,
+               size_average: bool = False, precision=None) -> jnp.ndarray:
+    """SSIM of P same-shape image pairs through ONE stacked blur pass.
 
-    blur = functools.partial(_blur, window_size=window_size, sigma=sigma,
-                             precision=precision)
-    mu1 = blur(x)
-    mu2 = blur(y)
+    All 5 blur operands (x, y, x², y², xy) of all P pairs ride the batch
+    axis of a single Toeplitz pass — 2 einsums total, vs 10 per pair as
+    standalone `ssim()` calls. The einsum's batch dimension contracts each
+    image independently, so every per-pair result is bit-identical to its
+    standalone call; the transposed (autodiff) einsums inherit the same
+    stacking, and pairs whose output is consumed under stop_gradient simply
+    contribute zero cotangent slices.
+
+    Args:
+      img1s, img2s: [P, B, C, H, W]
+      precision: "highest" | "default" | None | jax.lax.Precision
+        (resolve_precision semantics)
+    Returns: per-image means [P, B], or per-pair means [P] if size_average.
+    """
+    prec = resolve_precision(precision)
+    P, B, C, H, W = img1s.shape
+    x = jnp.transpose(img1s, (0, 1, 3, 4, 2)).astype(jnp.float32)
+    y = jnp.transpose(img2s, (0, 1, 3, 4, 2)).astype(jnp.float32)
+    x = x.reshape(P * B, H, W, C)
+    y = y.reshape(P * B, H, W, C)
+
+    stacked = jnp.concatenate([x, y, x * x, y * y, x * y], axis=0)
+    blurred = _blur(stacked, window_size, sigma, prec)
+    mu1, mu2, e_xx, e_yy, e_xy = jnp.split(blurred, 5, axis=0)
+
     mu1_sq = mu1 * mu1
     mu2_sq = mu2 * mu2
     mu1_mu2 = mu1 * mu2
-
-    sigma1_sq = blur(x * x) - mu1_sq
-    sigma2_sq = blur(y * y) - mu2_sq
-    sigma12 = blur(x * y) - mu1_mu2
+    sigma1_sq = e_xx - mu1_sq
+    sigma2_sq = e_yy - mu2_sq
+    sigma12 = e_xy - mu1_mu2
 
     c1 = 0.01 ** 2
     c2 = 0.03 ** 2
     ssim_map = ((2 * mu1_mu2 + c1) * (2 * sigma12 + c2)) / (
         (mu1_sq + mu2_sq + c1) * (sigma1_sq + sigma2_sq + c2))
 
-    if size_average:
-        return jnp.mean(ssim_map)
-    return jnp.mean(ssim_map, axis=(1, 2, 3))
+    per_image = jnp.mean(ssim_map, axis=(1, 2, 3)).reshape(P, B)
+    return jnp.mean(per_image, axis=1) if size_average else per_image
+
+
+def ssim(img1: jnp.ndarray, img2: jnp.ndarray,
+         window_size: int = 11, sigma: float = 1.5,
+         size_average: bool = True, precision=None) -> jnp.ndarray:
+    """SSIM between [B, C, H, W] images. Returns a scalar (size_average) or
+    per-image [B] means. Single-pair convenience wrapper over ssim_pairs;
+    `precision` follows resolve_precision (None -> Precision.HIGHEST)."""
+    per_image = ssim_pairs(img1[None], img2[None], window_size, sigma,
+                           size_average=False, precision=precision)[0]
+    return jnp.mean(per_image) if size_average else per_image

@@ -1,4 +1,4 @@
-"""The training loss graph — all four scales in one XLA program.
+"""The training loss graph — all four scales in one fused pyramid pass.
 
 Replaces SynthesisTask.loss_fcn / loss_fcn_per_scale / render_novel_view /
 compute_scale_factor (synthesis_task.py:211-401). Where the reference runs
@@ -6,6 +6,22 @@ each scale's rendering and losses as dozens of separate CUDA kernels, here the
 whole graph (forward, 4x render, all loss terms) is a single jit region that
 XLA fuses; multi-device runs shard it over the ("data", "plane") mesh via
 sharding constraints and GSPMD-inserted collectives.
+
+Fused pyramid pass (the PR-2 restructure): instead of four independent scale
+subgraphs that each re-derive their inputs, `build_scale_plan` computes the
+batch-only-dependent work ONCE per step —
+  * src/tgt nearest-neighbor pyramids as a cascade (scale s is scale s-1
+    strided by 2; stride composition from index 0 makes x[::2][::2] the same
+    elements as x[::4], so every level is bit-identical to slicing full-res)
+  * per-scale intrinsics / inverse intrinsics / cached pixel grids
+  * the sobel edge masks and finite-diff image gradients the edge-aware
+    smoothness terms need (functions of the images only, previously
+    recomputed inside every edge_aware_loss call site)
+and `loss_per_scale` consumes its precomputed `ScaleInputs`. The two SSIM
+evaluations per scale (src + tgt pairs) run through one stacked
+`ssim_pairs` call — 2 Toeplitz blur einsums per scale instead of 20 (see
+losses/ssim.py) — and the |syn - gt| diffs feed the rgb terms from named
+intermediates instead of being re-expressed per term.
 
 Semantics preserved (checked term by term against the reference):
   * nearest-neighbor image pyramid via strided slicing (== nn.Upsample(size),
@@ -28,23 +44,89 @@ Deviations (documented):
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from mine_tpu import geometry
 from mine_tpu.config import MPIConfig
-from mine_tpu.losses import edge_aware_loss, edge_aware_loss_v2, psnr, ssim
+from mine_tpu.losses import (edge_aware_image_masks, edge_aware_loss,
+                             edge_aware_loss_v2, image_mean_abs_grads, psnr,
+                             ssim_pairs)
 from mine_tpu.losses import lpips as lpips_mod
 from mine_tpu.ops import rendering, sampling
 from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS, constrain
 
 Batch = Dict[str, jnp.ndarray]
 
+NUM_SCALES = 4
+
 
 def nchw(img_nhwc: jnp.ndarray) -> jnp.ndarray:
     return jnp.transpose(img_nhwc, (0, 3, 1, 2))
+
+
+class ScaleInputs(NamedTuple):
+    """Batch-derived inputs for one pyramid scale, precomputed once per step
+    by build_scale_plan. Mask/grad fields are None when the config never
+    consumes them (their loss term's lambda is 0), so no dead subgraph is
+    traced."""
+    src_imgs: jnp.ndarray            # [B,3,Hs,Ws] nearest pyramid level
+    tgt_imgs: jnp.ndarray            # [B,3,Hs,Ws]
+    K_src: jnp.ndarray               # [B,3,3] scaled intrinsics
+    K_tgt: jnp.ndarray               # [B,3,3]
+    K_src_inv: jnp.ndarray           # [B,3,3]
+    grid: jnp.ndarray                # [3,Hs*Ws] homogeneous pixel grid
+    src_edge_masks: Optional[Tuple[jnp.ndarray, jnp.ndarray]]
+    tgt_edge_masks: Optional[Tuple[jnp.ndarray, jnp.ndarray]]
+    src_img_grads: Optional[Tuple[jnp.ndarray, jnp.ndarray]]
+    tgt_img_grads: Optional[Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def build_scale_plan(batch: Batch, cfg: MPIConfig,
+                     num_scales: int = NUM_SCALES) -> Tuple[ScaleInputs, ...]:
+    """Precompute every batch-only-dependent per-scale input.
+
+    The pyramids are built as a cascade — each level strided from the level
+    above. Strides compose from index 0 (x[::2][::2] picks exactly the
+    elements of x[::4]), so every level is bit-identical to the old per-scale
+    `full[:, :, ::2**s, ::2**s]` while touching 1/4 the data per level.
+    Intrinsics halving is exact in binary floating point, so the hoisted
+    `scale_intrinsics` results match the old per-scale calls bitwise.
+    """
+    src = nchw(batch["src_img"])
+    tgt = nchw(batch["tgt_img"])
+
+    # src edge masks feed the always-logged loss_smooth_src; the others are
+    # gated by their term's lambda exactly as the loss terms themselves are.
+    need_src_masks = True
+    need_tgt_masks = cfg.smoothness_lambda_v1 != 0.0
+    need_grads = cfg.smoothness_lambda_v2 != 0.0
+
+    plan = []
+    for scale in range(num_scales):
+        if scale > 0:
+            src = src[:, :, ::2, ::2]
+            tgt = tgt[:, :, ::2, ::2]
+        Hs, Ws = src.shape[2], src.shape[3]
+        K_src = geometry.scale_intrinsics(batch["K_src"], scale)
+        K_tgt = geometry.scale_intrinsics(batch["K_tgt"], scale)
+        plan.append(ScaleInputs(
+            src_imgs=src,
+            tgt_imgs=tgt,
+            K_src=K_src,
+            K_tgt=K_tgt,
+            K_src_inv=geometry.inverse_intrinsics(K_src),
+            grid=geometry.cached_pixel_grid(Hs, Ws),
+            src_edge_masks=(edge_aware_image_masks(
+                src, cfg.smoothness_grad_ratio) if need_src_masks else None),
+            tgt_edge_masks=(edge_aware_image_masks(
+                tgt, cfg.smoothness_grad_ratio) if need_tgt_masks else None),
+            src_img_grads=(image_mean_abs_grads(src) if need_grads else None),
+            tgt_img_grads=(image_mean_abs_grads(tgt) if need_grads else None),
+        ))
+    return tuple(plan)
 
 
 def compute_scale_factor(disparity_syn_pt3d: jnp.ndarray,
@@ -90,6 +172,7 @@ def _disp_loss(disp_syn_at_pts: jnp.ndarray, pt3d_disp: jnp.ndarray,
 
 
 def loss_per_scale(scale: int,
+                   plan_s: ScaleInputs,
                    mpi: jnp.ndarray,
                    disparity: jnp.ndarray,
                    batch: Batch,
@@ -106,6 +189,7 @@ def loss_per_scale(scale: int,
     """One pyramid scale of the loss graph (synthesis_task.py:230-373).
 
     Args:
+      plan_s: this scale's precomputed ScaleInputs (build_scale_plan)
       mpi: [B,S,4,Hs,Ws] decoder output at this scale
       disparity: [B,S]
       scale_factor: [B] or None (computed here at scale 0)
@@ -119,17 +203,13 @@ def loss_per_scale(scale: int,
     mathematically identical to the reference's whole-batch means because
     all examples share one image size.
     """
-    f = 2 ** scale
-    src_imgs = nchw(batch["src_img"])[:, :, ::f, ::f]  # nearest pyramid
-    tgt_imgs = nchw(batch["tgt_img"])[:, :, ::f, ::f]
-    B, _, Hs, Ws = src_imgs.shape
+    src_imgs = plan_s.src_imgs
+    tgt_imgs = plan_s.tgt_imgs
+    B = src_imgs.shape[0]
 
-    K_src = geometry.scale_intrinsics(batch["K_src"], scale)
-    K_tgt = geometry.scale_intrinsics(batch["K_tgt"], scale)
-    K_src_inv = geometry.inverse_intrinsics(K_src)
+    K_src, K_tgt, K_src_inv = plan_s.K_src, plan_s.K_tgt, plan_s.K_src_inv
 
-    grid = geometry.cached_pixel_grid(Hs, Ws)
-    xyz_src = geometry.plane_xyz_src(grid, disparity, K_src_inv)
+    xyz_src = geometry.plane_xyz_src(plan_s.grid, disparity, K_src_inv)
     xyz_src = constrain(xyz_src, mesh, DATA_AXIS, PLANE_AXIS)
 
     mpi = constrain(mpi, mesh, DATA_AXIS, PLANE_AXIS)
@@ -198,19 +278,27 @@ def loss_per_scale(scale: int,
     def pex(x):  # per-example mean, [B,...] -> [B]
         return jnp.mean(x, axis=tuple(range(1, x.ndim)))
 
+    # shared photometric intermediates: each |syn - gt| diff is one named
+    # tensor feeding its rgb term (and XLA reuses it wherever else it fuses)
+    abs_diff_src = jnp.abs(src_syn - src_imgs)
+    abs_diff_tgt = jnp.abs(tgt_syn - tgt_imgs)
+
+    # both SSIM pairs (tgt drives gradient, src is logged) through ONE
+    # stacked blur pass: 2 Toeplitz einsums for the whole scale
+    with jax.named_scope(f"ssim_pairs_s{scale}"):
+        ssim_both = ssim_pairs(
+            jnp.stack([tgt_syn, src_syn]), jnp.stack([tgt_imgs, src_imgs]),
+            size_average=False, precision=cfg.ssim_precision)  # [2,B]
+
     # src-view photometrics: logged, no gradient (synthesis_task.py:301-306)
-    loss_rgb_src = jax.lax.stop_gradient(agg(pex(jnp.abs(src_syn - src_imgs))))
-    ssim_prec = cfg.ssim_precision  # "highest" -> Precision.HIGHEST in ssim()
-    if ssim_prec == "highest":
-        ssim_prec = None
-    loss_ssim_src = jax.lax.stop_gradient(
-        agg(1.0 - ssim(src_syn, src_imgs, size_average=False,
-                       precision=ssim_prec)))
+    loss_rgb_src = jax.lax.stop_gradient(agg(pex(abs_diff_src)))
+    loss_ssim_src = jax.lax.stop_gradient(agg(1.0 - ssim_both[1]))
     loss_smooth_src = jax.lax.stop_gradient(
         agg(edge_aware_loss(src_imgs, src_disp_syn,
                             gmin=cfg.smoothness_gmin,
                             grad_ratio=cfg.smoothness_grad_ratio,
-                            size_average=False)))
+                            size_average=False,
+                            edge_masks=plan_s.src_edge_masks)))
 
     if cfg.use_disparity_loss:
         loss_disp_src = agg(_disp_loss(src_pt_disp_syn, src_pt_disp,
@@ -227,22 +315,23 @@ def loss_per_scale(scale: int,
 
     # tgt rgb, masked to pixels covered by enough warped planes (:324-328)
     valid = (tgt_mask >= cfg.valid_mask_threshold).astype(jnp.float32)
-    loss_rgb_tgt = agg(pex(jnp.abs(tgt_syn - tgt_imgs) * valid))
-    loss_ssim_tgt = agg(1.0 - ssim(tgt_syn, tgt_imgs, size_average=False,
-                                   precision=ssim_prec))
+    loss_rgb_tgt = agg(pex(abs_diff_tgt * valid))
+    loss_ssim_tgt = agg(1.0 - ssim_both[0])
 
     if cfg.smoothness_lambda_v1 != 0.0:
         loss_smooth_tgt = cfg.smoothness_lambda_v1 * agg(edge_aware_loss(
             tgt_imgs, tgt_disp_syn,
             gmin=cfg.smoothness_gmin, grad_ratio=cfg.smoothness_grad_ratio,
-            size_average=False))
+            size_average=False, edge_masks=plan_s.tgt_edge_masks))
     else:
         loss_smooth_tgt = zero
     if cfg.smoothness_lambda_v2 != 0.0:
         loss_smooth_src_v2 = cfg.smoothness_lambda_v2 * agg(
-            edge_aware_loss_v2(src_imgs, src_disp_syn, size_average=False))
+            edge_aware_loss_v2(src_imgs, src_disp_syn, size_average=False,
+                               img_grads=plan_s.src_img_grads))
         loss_smooth_tgt_v2 = cfg.smoothness_lambda_v2 * agg(
-            edge_aware_loss_v2(tgt_imgs, tgt_disp_syn, size_average=False))
+            edge_aware_loss_v2(tgt_imgs, tgt_disp_syn, size_average=False,
+                               img_grads=plan_s.tgt_img_grads))
     else:
         loss_smooth_src_v2 = zero
         loss_smooth_tgt_v2 = zero
@@ -306,27 +395,29 @@ def compute_losses(mpi_list,
                    example_weight=None):
     """All scales + aggregation (synthesis_task.loss_fcn :375-401).
 
-    Total = full term set at scale 0, plus per extra scale: rgb+ssim (if
-    use_multi_scale), the two sparse-disparity terms, and both v2 smoothness
-    terms (:394-400).
+    Builds the shared ScalePlan once, then evaluates every scale against its
+    precomputed inputs. Total = full term set at scale 0, plus per extra
+    scale: rgb+ssim (if use_multi_scale), the two sparse-disparity terms,
+    and both v2 smoothness terms (:394-400).
     Returns: (total_loss, metrics_dict_scale0, visuals_scale0)
     """
     G_tgt_src = geometry.rigid_inverse(batch["G_src_tgt"])
+    plan = build_scale_plan(batch, cfg, num_scales=NUM_SCALES)
 
     scale_factor = None
     dicts = []
     visuals0 = None
-    for scale in range(4):
+    for scale in range(NUM_SCALES):
         ld, vis, scale_factor = loss_per_scale(
-            scale, mpi_list[scale], disparity, batch, G_tgt_src, cfg,
-            scale_factor, mesh=mesh, is_val=is_val, lpips_params=lpips_params,
-            example_weight=example_weight)
+            scale, plan[scale], mpi_list[scale], disparity, batch, G_tgt_src,
+            cfg, scale_factor, mesh=mesh, is_val=is_val,
+            lpips_params=lpips_params, example_weight=example_weight)
         dicts.append(ld)
         if scale == 0:
             visuals0 = vis
 
     total = dicts[0]["loss"]
-    for s in range(1, 4):
+    for s in range(1, NUM_SCALES):
         if cfg.use_multi_scale:
             total = total + dicts[s]["loss_rgb_tgt"] + dicts[s]["loss_ssim_tgt"]
         total = total + dicts[s]["loss_disp_pt3dsrc"] + dicts[s]["loss_disp_pt3dtgt"]

@@ -52,6 +52,8 @@ QUICK = {
     "test_kitti.py::test_calib_parsing_and_geometry",
     "test_loop.py::test_average_meter",
     "test_loss_aggregation.py::test_compute_scale_factor_formula",
+    "test_fused_loss.py::test_ssim_pairs_matches_separate_calls",
+    "test_step_breakdown.py::test_parse_extracts_all_buckets",
     "test_losses.py::test_psnr_analytic",
     "test_mesh.py::test_num_slices",
     "test_models.py::test_positional_encoding_matches_reference_formula",
@@ -88,6 +90,9 @@ MEDIUM_FILES = {
     "test_pipeline.py",
     "test_checkpoint.py",
     "test_loss_aggregation.py",
+    # fused-pyramid equivalence vs the frozen per-scale reference (PR-2
+    # tentpole): what a reviewer most wants re-run after touching the loss
+    "test_fused_loss.py",
     "test_packed_decoder.py",
     # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
     # eval): the closest thing to a real-data rehearsal, gated here so it

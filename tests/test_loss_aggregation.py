@@ -52,13 +52,16 @@ def test_project_points():
 
 
 def _fake_scales(monkeypatch, values):
-    """Patch loss_per_scale to return synthetic per-scale dicts."""
-    def fake(scale, mpi, disparity, batch, G, cfg, scale_factor, **kw):
+    """Patch loss_per_scale to return synthetic per-scale dicts (and
+    build_scale_plan to a no-op: the synthetic batch has no images)."""
+    def fake(scale, plan_s, mpi, disparity, batch, G, cfg, scale_factor, **kw):
         v = values[scale]
         d = {k: jnp.asarray(val, jnp.float32) for k, val in v.items()}
         return d, {"vis": scale}, jnp.ones((1,))
 
     monkeypatch.setattr(loss_mod, "loss_per_scale", fake)
+    monkeypatch.setattr(loss_mod, "build_scale_plan",
+                        lambda batch, cfg, num_scales=4: (None,) * num_scales)
 
 
 def test_aggregation_multi_scale(monkeypatch):

@@ -1,0 +1,88 @@
+"""tools/step_breakdown.py (shipped in PR 1 with zero tests): the parser
+must extract exactly the loop's `time: step = ...` breakdown lines, and the
+summary's arithmetic — component means, host-bound fraction, which knob the
+hint names — is pinned here against synthetic logs."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from step_breakdown import KEYS, parse_lines, summarize
+
+
+def _line(step, host_wait, device, h2d):
+    return ("time: step = %.1f ms host_wait = %.1f ms device = %.1f ms "
+            "h2d = %.1f ms" % (step, host_wait, device, h2d))
+
+
+def _log(rows):
+    """Interleave breakdown rows with the other train-loop log chatter."""
+    lines = ["epoch 0 step 0 loss = 1.234 lr = 1.0e-03"]
+    for r in rows:
+        lines.append(_line(*r))
+        lines.append("epoch 0 step 10 loss = 1.100 lr = 1.0e-03")
+    lines.append("time: step = not-a-number ms")  # malformed: must be skipped
+    return lines
+
+
+def test_parse_extracts_all_buckets():
+    rows = [(812.0, 590.1, 221.9, 35.2), (640.0, 400.0, 240.0, 12.5)]
+    samples = parse_lines(_log(rows))
+    assert set(samples) == set(KEYS)
+    for i, key in enumerate(KEYS):
+        np.testing.assert_allclose(samples[key], [r[i] for r in rows])
+
+
+def test_components_approximately_sum_to_step():
+    """Synthetic log built with step = host_wait + device (h2d inside
+    host_wait, as the loop measures it): the parsed buckets must satisfy
+    the same identity — the breakdown is a partition, not four unrelated
+    clocks."""
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(20):
+        # components pre-rounded to the log's %.1f so the printed step equals
+        # the printed parts exactly (no formatting round-off in the identity)
+        device = round(rng.uniform(180, 260), 1)
+        h2d = round(rng.uniform(5, 40), 1)
+        host_wait = round(h2d + rng.uniform(0, 500), 1)
+        rows.append((host_wait + device, host_wait, device, h2d))
+    s = parse_lines(_log(rows))
+    step = np.asarray(s["step"])
+    np.testing.assert_allclose(
+        np.asarray(s["host_wait"]) + np.asarray(s["device"]), step, rtol=1e-6)
+    assert np.all(np.asarray(s["h2d"]) <= np.asarray(s["host_wait"]) + 1e-9)
+
+
+def test_summarize_empty_log():
+    out = summarize(parse_lines(["no breakdown here", "loss = 1.0"]))
+    assert "no 'time: step" in out
+
+
+def test_summarize_means_and_assembly_hint():
+    # host-bound (60%) with small h2d -> assembly-bound hint (workers knob)
+    rows = [(1000.0, 600.0, 400.0, 50.0)] * 4
+    out = summarize(parse_lines(_log(rows)))
+    assert "over 4 log intervals" in out
+    assert "1000.0" in out and "600.0" in out
+    assert "60.0%" in out
+    assert "data.num_workers" in out
+    assert "staging_buffers" not in out
+
+
+def test_summarize_copy_bound_hint():
+    # host_wait dominated by h2d -> copy-bound hint (staging buffers knob)
+    rows = [(500.0, 300.0, 200.0, 280.0)] * 3
+    out = summarize(parse_lines(_log(rows)))
+    assert "copy-bound" in out and "data.staging_buffers" in out
+
+
+def test_summarize_device_bound_no_hint():
+    # healthy pipeline: host_wait 5% -> no knob hint at all
+    rows = [(210.0, 10.0, 200.0, 5.0)] * 3
+    out = summarize(parse_lines(_log(rows)))
+    assert "hint" not in out

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Audit a jitted train_step for bf16 -> f32 upcasts.
+
+ROADMAP's "73 ms elementwise tail" names accidental f32 upcasts inside the
+bf16 conv stacks as a suspect: a stray `convert_element_type` widening
+activations back to f32 doubles that tensor's HBM traffic and drags the
+surrounding fusion to f32 VPU throughput. XLA inserts converts for good
+reasons too (f32 BN statistics, the f32 loss graph, optimizer math), so the
+audit REPORTS AND RANKS rather than fails: every bf16->f32 convert in the
+StableHLO of `SynthesisTrainer._train_step`, grouped by source scope, with
+element counts so the expensive ones sort first, and a separate "conv-stack"
+section for the converts that sit inside encoder/decoder scopes — those are
+the ones worth chasing.
+
+Known-benign scope patterns are annotated inline (column `why`) so a clean
+report is readable at a glance: anything un-annotated inside a conv scope
+is a real suspect.
+
+Usage:
+  python tools/dtype_audit.py                  # north-star bench shape
+  python tools/dtype_audit.py --small          # tiny shapes (seconds, CPU)
+  python tools/dtype_audit.py --dtype float32  # control: no bf16 anywhere
+  python tools/dtype_audit.py --top 40         # widen the report
+
+Trace-only (jit .lower(), never compiles or runs), so it works on the CPU
+container without a TPU window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# convert ops in StableHLO text:
+#   %5 = stablehlo.convert %4 : (tensor<2x64x96x256xbf16>) -> tensor<...xf32> loc(#loc123)
+_CONVERT_RE = re.compile(
+    r"stablehlo\.convert\s+%[\w.#]+\s*:\s*"
+    r"\(tensor<([0-9x]*?)x?bf16>\)\s*->\s*tensor<[0-9x]*?x?f32>"
+    r"(?:\s+loc\((#?\w+|\"[^\"]*\".*?)\))?")
+# location table entries at the bottom of a debug_info=True module:
+#   #loc123 = loc("jit(_train_step_impl)/convert_element_type"(#loc7))
+_LOCDEF_RE = re.compile(r"^(#\w+)\s*=\s*loc\((.*)\)\s*$", re.M)
+_LOCNAME_RE = re.compile(r"\"([^\"]+)\"")
+
+# scope substrings whose bf16->f32 converts are expected and justified —
+# annotated in the report, never counted as conv-stack suspects
+JUSTIFIED = (
+    ("batch_norm", "f32 BN statistics (SyncBN numerics)"),
+    ("/bn", "f32 BN statistics (SyncBN numerics)"),
+    ("_bn", "f32 BN statistics (SyncBN numerics)"),
+    ("loss", "loss graph is f32 by design"),
+    ("ssim", "loss graph is f32 by design"),
+    ("adam", "f32 optimizer math"),
+    ("opt", "f32 optimizer math"),
+    ("transpose(jvp", "autodiff of an f32 region"),
+    # the decoder module's OWN top-level convert (not one inside a sublayer):
+    # the final [S,H,W,4] mpi outputs widening into the f32 loss graph
+    ("decoder/convert_element_type", "decoder output -> f32 loss boundary"),
+)
+
+
+def _elements(shape_str: str) -> int:
+    n = 1
+    for d in shape_str.split("x"):
+        if d:
+            n *= int(d)
+    return n
+
+
+def _loc_names(text: str):
+    """#locN -> innermost quoted name (resolving one level of nesting)."""
+    raw = dict(_LOCDEF_RE.findall(text))
+    names = {}
+    for key, body in raw.items():
+        m = _LOCNAME_RE.search(body)
+        if m is None:  # alias like #loc5 = loc(#loc3)
+            ref = re.search(r"#\w+", body)
+            body2 = raw.get(ref.group(0), "") if ref else ""
+            m = _LOCNAME_RE.search(body2)
+        names[key] = m.group(1) if m else "?"
+    return names
+
+
+def collect_upcasts(stablehlo_text: str):
+    """All bf16->f32 converts in a StableHLO module.
+
+    Returns a list of dicts {shape: str, elements: int, scope: str}; scope
+    is the jax name-stack string when the module was lowered with
+    debug_info=True, else "?".
+    """
+    loc_names = _loc_names(stablehlo_text)
+    out = []
+    for m in _CONVERT_RE.finditer(stablehlo_text):
+        shape, loc = m.group(1), m.group(2)
+        if loc is None:
+            scope = "?"
+        elif loc.startswith("#"):
+            scope = loc_names.get(loc, "?")
+        else:
+            nm = _LOCNAME_RE.search(loc)
+            scope = nm.group(1) if nm else "?"
+        # drop the shared jit(...)/jit(main)/ prefix — pure column noise
+        scope = re.sub(r"^(jit\([^)]*\)/)+", "", scope)
+        out.append({"shape": shape or "scalar",
+                    "elements": _elements(shape),
+                    "scope": scope})
+    return out
+
+
+def _justification(scope: str):
+    s = scope.lower()
+    for pat, why in JUSTIFIED:
+        if pat in s:
+            return why
+    return ""
+
+
+_CONV_STACK_RE = re.compile(r"conv(?!ert)|resnet|decoder|encoder")
+
+
+def in_conv_stack(scope: str) -> bool:
+    """Scopes inside the encoder/decoder conv stacks (the model forward),
+    where a widening convert means bf16 discipline was lost. `conv(?!ert)`:
+    every convert op's own scope component spells "convert_element_type",
+    which must not read as a conv layer."""
+    return _CONV_STACK_RE.search(scope.lower()) is not None
+
+
+def summarize(upcasts, top: int = 25) -> str:
+    if not upcasts:
+        return ("no bf16->f32 converts found "
+                "(f32-only program, or bf16 never widened)")
+    groups = {}
+    for u in upcasts:
+        key = (u["scope"], u["shape"])
+        g = groups.setdefault(key, {"count": 0, "elements": 0})
+        g["count"] += 1
+        g["elements"] += u["elements"]
+    rows = sorted(groups.items(), key=lambda kv: -kv[1]["elements"])
+    total_el = sum(u["elements"] for u in upcasts)
+    out = ["bf16 -> f32 convert_element_type report: %d converts, %.2f M "
+           "elements total" % (len(upcasts), total_el / 1e6),
+           "  %-12s %6s %10s  %-40s %s"
+           % ("shape", "count", "elements", "scope", "why")]
+    for (scope, shape), g in rows[:top]:
+        out.append("  %-12s %6d %10d  %-40s %s"
+                   % (shape[:12], g["count"], g["elements"], scope[:40],
+                      _justification(scope)))
+    if len(rows) > top:
+        out.append("  ... %d more groups (--top to widen)" % (len(rows) - top))
+
+    suspects = [u for u in upcasts
+                if in_conv_stack(u["scope"]) and not _justification(u["scope"])]
+    if suspects:
+        el = sum(u["elements"] for u in suspects)
+        out.append("CONV-STACK SUSPECTS: %d converts / %.2f M elements widen "
+                   "bf16 activations inside encoder/decoder scopes — chase "
+                   "these first" % (len(suspects), el / 1e6))
+    else:
+        out.append("conv-stack: clean (every convert is outside the "
+                   "encoder/decoder scopes or justified)")
+    return "\n".join(out)
+
+
+def audit_trainer(trainer, state, batch):
+    """bf16->f32 upcast list for one trainer's jitted train step."""
+    lowered = trainer._train_step.lower(state, batch)
+    try:
+        # the MLIR asm printer is the one path that emits the loc table
+        # (name-stack scopes) on this jax version; Lowered.as_text() drops it
+        text = lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
+            enable_debug_info=True, large_elements_limit=8)
+    except Exception:  # pragma: no cover - fallback: converts still counted,
+        text = lowered.as_text()  # but every scope reads "?"
+    return collect_upcasts(text)
+
+
+def build_trainer(height, width, planes, layers, batch_size, dtype,
+                  config_path=None):
+    import jax.numpy as jnp
+
+    from mine_tpu.config import CONFIG_DIR, load_config
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.train.step import SynthesisTrainer
+
+    config = load_config(config_path
+                         or os.path.join(CONFIG_DIR, "params_llff.yaml"))
+    config.update({
+        "data.img_h": height, "data.img_w": width,
+        "mpi.num_bins_coarse": planes,
+        "model.num_layers": layers,
+        "data.per_gpu_batch_size": batch_size,
+        "training.dtype": dtype,
+        # audit the portable program, not a TPU-only lowering
+        "training.warp_backend": "xla",
+        "training.composite_backend": "xla",
+    })
+    trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+    state = trainer.init_state(batch_size=batch_size)
+    batch = {k: jnp.asarray(v) for k, v in
+             make_batch(batch_size, height, width, num_points=256).items()}
+    return trainer, state, batch
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default=None, help="config YAML "
+                    "(default: shipped params_llff.yaml)")
+    ap.add_argument("--small", action="store_true",
+                    help="64x64 / 4 planes / resnet18 — seconds on CPU")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"))
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args(argv)
+
+    if args.small:
+        h, w, planes, layers, batch = 64, 64, 4, 18, 1
+    else:  # the bench north-star shape (trace-only: no chip needed)
+        h, w, planes, layers, batch = 256, 384, 32, 50, 4
+
+    trainer, state, batch_arrays = build_trainer(
+        h, w, planes, layers, batch, args.dtype, config_path=args.config)
+    upcasts = audit_trainer(trainer, state, batch_arrays)
+    print("train_step @ %dx%d N=%d resnet%d B=%d dtype=%s"
+          % (h, w, planes, layers, batch, args.dtype))
+    print(summarize(upcasts, top=args.top))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Canonical tier-1 verification — the EXACT pytest line from ROADMAP.md
+# ("Tier-1 verify"), wrapped so builders and CI run one command and get a
+# pass-count delta against the checked-in baseline instead of eyeballing
+# dots. Exit code is the pytest exit code; the DOTS_PASSED line at the end
+# is the number the ROADMAP contract compares.
+#
+# Usage: tools/verify_tier1.sh
+# Baseline: tools/tier1_baseline.txt (update it in the same commit as any
+# intentional test-count change, with a line in CHANGES.md saying why).
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+LOG=/tmp/_t1.log
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+baseline=$(cat tools/tier1_baseline.txt 2>/dev/null || echo 0)
+delta=$((passed - baseline))
+echo "DOTS_PASSED=$passed (baseline $baseline, delta ${delta#+})"
+if [ "$passed" -lt "$baseline" ]; then
+    echo "REGRESSION: tier-1 pass count dropped below the checked-in baseline"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+exit "$rc"

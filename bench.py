@@ -165,6 +165,27 @@ VARIANTS = {
     # without a full soak; compare against the pre-fusion row in
     # BENCH_NOTES to price the shared-pyramid/batched-SSIM win on chip.
     "losspass_b4": (4, {}),
+    # WARP-ONLY row (not a train-step variant): times homography_warp
+    # fwd+bwd in isolation on fixed decoder outputs — losspass_b4 one layer
+    # deeper — once per warp backend (xla / xla_banded / pallas_diff /
+    # separable / pallas_sep; per-backend img/s on stderr, JSON ips = the
+    # separable reading). THE chip measurement for the separable-warp
+    # tentpole, and the only way to price xla_banded on this toolchain:
+    # the banded op measures fine standalone while the full step trips the
+    # server-side compiler crash (tools/repro_banded_compile.py). The
+    # sep_tol ACCURACY gate is disabled for this row (speed is
+    # pose-independent; the synthetic bench poses carry ~1.5 px of
+    # within-row drift and would otherwise price the gather fallback) —
+    # the band-fit guard still applies and the in_domain stderr field
+    # says which path each row actually timed.
+    "warppass_b4": (4, {"training.warp_sep_tol": 1e6}),
+    # SSIM-PRECISION A/B row: two losspass measurements over the same
+    # program, training.ssim_precision=highest (shipped default, exact-f32
+    # blur einsums) vs default (platform precision — bf16 MXU on TPU).
+    # The decision number for flipping the shipped default (ROADMAP "SSIM
+    # blur precision" item); JSON ips = the "highest" reading, directly
+    # comparable to losspass_b4.
+    "ssim_precision_ab": (4, {}),
     # END-TO-END pipeline-fed loop (not a resident-batch device-step
     # variant): threaded batch assembly + double-buffered device staging
     # feeding the jitted step, fresh batch every step with the input
@@ -176,7 +197,10 @@ VARIANTS = {
 }
 
 
-def _variant_config(name):
+def _variant_config(name, extra=None):
+    """Variant config; `extra` layers measurement-local overrides on top of
+    the variant's own (the A/B rows run one program twice with one knob
+    flipped — the knob is the measurement's, not the variant's)."""
     from mine_tpu.config import CONFIG_DIR, load_config
     batch, overrides = VARIANTS[name]
     config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"))
@@ -188,12 +212,13 @@ def _variant_config(name):
         "data.per_gpu_batch_size": batch,
     })
     config.update(overrides)
+    config.update(extra or {})
     if SMOKE:  # harness self-test: tiny shapes beat any variant override
         config.update({"data.img_h": HEIGHT, "data.img_w": WIDTH})
     return config, batch
 
 
-def build_variant_program(name):
+def build_variant_program(name, extra=None):
     """(trainer, state, batch) for a variant — THE program a measurement
     runs. Shared with tools/tpu_crosscheck.py so pre-window TPU
     cross-lowering validates exactly what the window compiles."""
@@ -202,7 +227,7 @@ def build_variant_program(name):
     from mine_tpu.data.synthetic import make_batch
     from mine_tpu.train.step import SynthesisTrainer
 
-    config, batch_size = _variant_config(name)
+    config, batch_size = _variant_config(name, extra=extra)
     trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
     state = trainer.init_state(batch_size=batch_size)
     h, w = int(config["data.img_h"]), int(config["data.img_w"])
@@ -278,7 +303,7 @@ def _measure_realloop(name, steps=MEASURE_STEPS, keep_run=False):
         batch_size
 
 
-def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False):
+def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False, extra=None):
     """Loss-graph-only measurement (the losspass_* variants).
 
     The model forward runs ONCE outside the timed region (exactly the key
@@ -294,7 +319,7 @@ def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False):
     from mine_tpu.train import loss as loss_mod
     from mine_tpu.train.step import sample_disparity
 
-    trainer, state, batch = build_variant_program(name)
+    trainer, state, batch = build_variant_program(name, extra=extra)
     batch_size = int(batch["src_img"].shape[0])
 
     key = jax.random.fold_in(state.rng, state.step)
@@ -339,6 +364,132 @@ def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False):
         batch_size
 
 
+# the warppass sub-sweep order: gather reference first, then the banded
+# family in FLOP order; the separable XLA row is the JSON headline
+WARPPASS_BACKENDS = ("xla", "xla_banded", "pallas_diff", "separable",
+                     "pallas_sep")
+
+
+def _measure_warppass(name, steps=MEASURE_STEPS, keep_run=False):
+    """Warp-only measurement (the warppass_* variants).
+
+    losspass_b4 one layer deeper: the model forward runs ONCE outside the
+    timed region, the scale-0 warp inputs are derived exactly as
+    loss_per_scale derives them (unit scale factor), and each warp backend
+    gets its own jitted value_and_grad of sum(homography_warp(volume))
+    with respect to the 7-channel plane volume. Per-backend img/s and the
+    in-domain flag go to stderr (a 0.0 flag means that row priced the
+    gather FALLBACK, not the banded path — same honesty rule as the
+    warp_fallback_frac training metric); the JSON ips is the SEPARABLE
+    backend's reading."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu import geometry
+    from mine_tpu.ops import warp
+    from mine_tpu.train import loss as loss_mod
+    from mine_tpu.train.step import sample_disparity
+
+    trainer, state, batch = build_variant_program(name)
+    batch_size = int(batch["src_img"].shape[0])
+    cfg = trainer.cfg
+
+    key = jax.random.fold_in(state.rng, state.step)
+    d_key, f_key, drop_key = jax.random.split(key, 3)
+    disparity = sample_disparity(d_key, batch_size, trainer.cfg)
+    mpi_list, disparity_all, _ = trainer._forward(
+        state.params, state.batch_stats, batch, disparity, f_key, drop_key,
+        train=True)
+
+    # scale-0 warp inputs, derived as loss_per_scale derives them
+    # (train/loss.py) with a unit scale factor
+    p0 = loss_mod.build_scale_plan(batch, cfg, num_scales=1)[0]
+    mpi = mpi_list[0]                                    # [B,S,4,H,W]
+    B, S, _, H, W = mpi.shape
+    xyz_src = geometry.plane_xyz_src(p0.grid, disparity_all, p0.K_src_inv)
+    G_tgt_src = jax.lax.stop_gradient(
+        geometry.rigid_inverse(batch["G_src_tgt"]))
+    xyz_tgt = geometry.plane_xyz_tgt(xyz_src, G_tgt_src)
+    volume = jnp.concatenate([mpi[:, :, 0:3], mpi[:, :, 3:4], xyz_tgt],
+                             axis=2).reshape(B * S, 7, H, W)
+    depths = (1.0 / disparity_all).reshape(B * S)
+
+    def expand(x):
+        return jnp.repeat(x, S, axis=0)
+
+    G_e, Ki_e, Kt_e = (expand(G_tgt_src), expand(p0.K_src_inv),
+                       expand(p0.K_tgt))
+    grid = geometry.cached_pixel_grid(H, W)
+    volume = jax.block_until_ready(volume)
+
+    sep_ips, sep_tflops, sep_run = None, None, None
+    for impl in WARPPASS_BACKENDS:
+
+        def warp_sum(vol, _impl=impl):
+            out, _, flag = warp.homography_warp(
+                vol, depths, G_e, Ki_e, Kt_e, grid, impl=_impl,
+                band=cfg.warp_band, with_domain_flag=True,
+                sep_tol=cfg.warp_sep_tol)
+            return jnp.sum(out), flag
+
+        lowered = jax.jit(
+            jax.value_and_grad(warp_sum, has_aux=True)).lower(volume)
+        tflops = None
+        try:
+            tflops = lowered.cost_analysis().get("flops", 0.0) / 1e12 or None
+        except Exception:
+            pass
+        fn = lowered.compile()
+        for _ in range(WARMUP_STEPS):
+            (total, flag), _g = fn(volume)
+        jax.block_until_ready(total)
+
+        def run(n, _fn=fn):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                (total, _flag), _g = _fn(volume)
+            float(jax.device_get(total))
+            return time.perf_counter() - t0
+
+        dt = run(steps)
+        ips = batch_size * steps / dt
+        in_domain = float(jax.device_get(flag))
+        print("  warppass[%s]: %d warp fwd+bwd in %.3fs (%.2f ms/step, "
+              "%.3f img/s, in_domain=%s)"
+              % (impl, steps, dt, 1e3 * dt / steps, ips,
+                 "n/a" if math.isnan(in_domain) else "%.2f" % in_domain),
+              file=sys.stderr)
+        if impl == "separable":
+            sep_ips, sep_tflops, sep_run = ips, tflops, run
+    return sep_ips, sep_tflops, (sep_run if keep_run else None), batch_size
+
+
+def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
+    """training.ssim_precision A/B (the ssim_precision_ab variants).
+
+    Two _measure_losspass runs of the SAME program with only the SSIM
+    blur-einsum precision flipped: "highest" (shipped default, exact-f32)
+    vs "default" (platform choice — bf16 MXU passes on TPU). The stderr
+    speedup line is the decision number for flipping the shipped default;
+    the returned ips is the "highest" reading so the row stays directly
+    comparable with losspass_b4."""
+    readings = {}
+    for mode in ("highest", "default"):
+        ips, tflops, run, batch = _measure_losspass(
+            name, steps=steps, keep_run=(keep_run and mode == "highest"),
+            extra={"training.ssim_precision": mode})
+        readings[mode] = (ips, tflops, run)
+        print("  ssim_precision_ab[%s]: %.3f img/s (loss graph only)"
+              % (mode, ips), file=sys.stderr)
+    print("  ssim_precision_ab: default/highest speedup %.2fx"
+          % (readings["default"][0] / readings["highest"][0]),
+          file=sys.stderr)
+    ips, tflops, run = readings["highest"]
+    return ips, tflops, run, batch
+
+
 def _measure(name, steps=MEASURE_STEPS, keep_run=False):
     """Compile + run one variant.
 
@@ -349,6 +500,10 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
 
     if name.startswith("realloop"):
         return _measure_realloop(name, steps=steps, keep_run=keep_run)
+    if name.startswith("warppass"):
+        return _measure_warppass(name, steps=steps, keep_run=keep_run)
+    if name.startswith("ssim_precision"):
+        return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):
         return _measure_losspass(name, steps=steps, keep_run=keep_run)
 

@@ -109,10 +109,13 @@ class MPIConfig:
     # platform); the shipped YAML default is "auto", resolved by
     # mpi_config_from_dict to pallas_diff on TPU / xla elsewhere
     composite_backend: str = "xla"
-    # "xla" | "xla_banded" | "pallas_diff": training-path homography warp
-    # ("xla_banded" = banded one-hot-matmul in pure XLA, ops/warp_banded.py;
-    # "pallas_diff" = banded MXU kernel fwd+bwd, kernels/warp_vjp.py; both
-    # carry a runtime gather fallback for rotation-heavy poses)
+    # "xla" | "xla_banded" | "pallas_diff" | "separable" | "pallas_sep":
+    # training-path homography warp ("xla_banded" = banded one-hot-matmul
+    # in pure XLA, ops/warp_banded.py; "pallas_diff" = banded MXU kernel
+    # fwd+bwd, kernels/warp_vjp.py; "separable" = row-then-column 1D
+    # one-hot matmuls in pure XLA, ops/warp_separable.py; "pallas_sep" =
+    # Pallas fwd+bwd pair of the separable form, kernels/warp_sep.py; all
+    # four carry a runtime gather fallback for out-of-domain poses)
     warp_backend: str = "xla"
     # fwd AND bwd band: since the round-4 transposed-splat backward the
     # Pallas VJP mirrors the forward's band placement, so one knob covers
@@ -125,6 +128,11 @@ class MPIConfig:
     # default xla backend (bf16 halves the volume's HBM traffic); either
     # way ~2^-8 relative value rounding, accumulation/lerp stays f32
     warp_dtype: str = "float32"
+    # separable backends only: max admitted per-row anchor deviation in
+    # source rows (value error is bounded by sep_tol * the image's vertical
+    # Lipschitz constant; ops/warp_separable.py docstring). Poses above it
+    # take the runtime gather fallback.
+    warp_sep_tol: float = 0.5
     # SSIM Toeplitz-einsum matmul precision ("highest" | "default"):
     # "highest" forces f32 MXU passes for the 11x11 Gaussian blur —
     # matches the reference's conv2d numerics exactly; "default" lets the
@@ -191,10 +199,15 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
             f"training.composite_backend must be auto|xla|pallas_diff|"
             f"plane_scan, got {backend!r}")
     warp_backend = _resolve_auto_backend(g("training.warp_backend", "auto"))
-    if warp_backend not in ("xla", "xla_banded", "pallas_diff"):
+    if warp_backend not in ("xla", "xla_banded", "pallas_diff",
+                            "separable", "pallas_sep"):
         raise ValueError(
-            f"training.warp_backend must be auto|xla|xla_banded|pallas_diff, "
-            f"got {warp_backend!r}")
+            f"training.warp_backend must be auto|xla|xla_banded|pallas_diff|"
+            f"separable|pallas_sep, got {warp_backend!r}")
+    warp_sep_tol = float(g("training.warp_sep_tol", 0.5))
+    if warp_sep_tol < 0.0:
+        raise ValueError(
+            f"training.warp_sep_tol must be >= 0, got {warp_sep_tol!r}")
     warp_dtype = g("training.warp_dtype", "float32")
     if warp_dtype not in ("float32", "bfloat16"):
         raise ValueError(
@@ -227,6 +240,7 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
         warp_backend=warp_backend,
         warp_band=int(g("training.warp_band", 48)),
         warp_dtype=warp_dtype,
+        warp_sep_tol=warp_sep_tol,
         ssim_precision=ssim_precision,
         # visible_point_count == 0 also disables the sparse-point terms —
         # datasets with no SfM points (public RealEstate10K) train scale-free

@@ -154,6 +154,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
                          warp_impl: str = "xla",
                          warp_band: int = 16,
                          warp_dtype: str = "float32",
+                         warp_sep_tol: float = 0.5,
                          mesh=None) -> TgtRender:
     """Render the MPI into a target camera.
 
@@ -194,6 +195,7 @@ def render_tgt_rgb_depth(mpi_rgb_src: jnp.ndarray,
         mesh=mesh,
         mxu_dtype=jnp.bfloat16 if warp_dtype == "bfloat16" else jnp.float32,
         with_domain_flag=True,
+        sep_tol=warp_sep_tol,
     )
 
     warped = warped.reshape(B, S, 7, H, W)

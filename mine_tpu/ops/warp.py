@@ -132,7 +132,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     band: int = 16,
                     mesh=None,
                     mxu_dtype=jnp.float32,
-                    with_domain_flag: bool = False):
+                    with_domain_flag: bool = False,
+                    sep_tol: float = 0.5):
     """Warp source-plane images into the target camera via inverse homography.
 
     For each batch element: compose H_tgt_src = K_tgt (R - t n^T / -d) K_src^-1,
@@ -151,26 +152,33 @@ def homography_warp(src_BCHW: jnp.ndarray,
       meshgrid_tgt: [3, Ht, Wt] homogeneous target pixel grid
       impl: "xla" (gather; autodiffed), "xla_banded" (banded one-hot-matmul
         in pure XLA with a runtime gather fallback — autodiffed, trainable,
-        GSPMD-partitionable; ops/warp_banded.py), "pallas" (banded MXU
-        gather kernel, forward-only; caller must validate the band via
-        kernels.warp.band_span), or "pallas_diff" (banded fwd+bwd kernels
-        with a built-in runtime gather fallback — the Pallas training
-        backend)
-      mesh: ("data","plane") jax Mesh. With impl="pallas_diff" on a
-        multi-device mesh the kernel runs under shard_map with the flat
-        B' axis split over data*plane (matching the decoder's B*S layout,
-        models/decoder.py shard_bs) — each device warps its local planes,
-        no cross-device traffic.
+        GSPMD-partitionable; ops/warp_banded.py), "separable" (row-then-
+        column 1D one-hot matmuls in pure XLA — ~(band+W)/(band*W) the
+        banded dot FLOPs, anchor-banded so the guard drops the within-row
+        span term; autodiffed, GSPMD-partitionable; ops/warp_separable.py),
+        "pallas" (banded MXU gather kernel, forward-only; caller must
+        validate the band via kernels.warp.band_span), "pallas_diff"
+        (banded fwd+bwd kernels with a built-in runtime gather fallback —
+        the Pallas training backend), or "pallas_sep" (Pallas fwd+bwd pair
+        of the separable form; kernels/warp_sep.py)
+      mesh: ("data","plane") jax Mesh. With impl="pallas_diff"/"pallas_sep"
+        on a multi-device mesh the kernel runs under shard_map with the
+        flat B' axis split over data*plane (matching the decoder's B*S
+        layout, models/decoder.py shard_bs) — each device warps its local
+        planes, no cross-device traffic.
       with_domain_flag: also return `in_domain`, a scalar f32 diagnostic —
         the FRACTION of this call that took the guarded banded backends'
-        (pallas_diff / xla_banded) fast path: 1.0 all-fast, 0.0 all on the
-        runtime gather fallback, NaN for backends with no guard (plain
-        xla / forward-only pallas). Under a sharded pallas_diff mesh the
-        cond decides per shard, and the flag is the pmean of the per-shard
-        guards over data*plane — e.g. 0.75 when one of four shards drew an
-        out-of-band pose (the pre-r6 global-coords flag reported 0.0 for
-        that step). Powers the `warp_fallback_frac` training metric
-        (VERDICT r4 weak item 5).
+        (pallas_diff / pallas_sep / xla_banded / separable) fast path:
+        1.0 all-fast, 0.0 all on the runtime gather fallback, NaN for
+        backends with no guard (plain xla / forward-only pallas). Under a
+        sharded Pallas mesh the cond decides per shard, and the flag is
+        the pmean of the per-shard guards over data*plane — e.g. 0.75 when
+        one of four shards drew an out-of-band pose (the pre-r6
+        global-coords flag reported 0.0 for that step). Powers the
+        `warp_fallback_frac` training metric (VERDICT r4 weak item 5).
+      sep_tol: separable backends only (training.warp_sep_tol) — max
+        admitted per-row anchor deviation in source rows; poses above it
+        take the gather fallback (ops/warp_separable.py error bound).
     Returns:
       tgt [B', C, Ht, Wt], valid_mask [B', Ht, Wt] (bool)
       [, in_domain scalar f32 — only when with_domain_flag]
@@ -198,31 +206,53 @@ def homography_warp(src_BCHW: jnp.ndarray,
         from mine_tpu.kernels.warp import pallas_bilinear_sample
         tgt = pallas_bilinear_sample(src_BCHW, x, y, band=band,
                                      interpret=not on_tpu_backend())
-    elif impl == "xla_banded":
-        # banded one-hot-matmul warp in pure XLA (ops/warp_banded.py):
+    elif impl in ("xla_banded", "separable"):
+        # banded / separable one-hot-matmul warps in pure XLA: both are
         # differentiable by autodiff and GSPMD-partitionable directly, so
         # no shard_map wrapper or mesh-divisibility guard is needed
-        from mine_tpu.ops import warp_banded
         xs = jax.lax.stop_gradient(x)
         ys = jax.lax.stop_gradient(y)
-        in_domain = warp_banded.guard_ok(
-            src_BCHW.shape, ys, band).astype(jnp.float32)
-        tgt = warp_banded.banded_bilinear_sample_guarded(
-            src_BCHW, xs, ys, band=band, mxu_dtype=mxu_dtype)
-    elif impl == "pallas_diff":
-        # training path: banded Pallas fwd+bwd with runtime gather fallback
-        # outside the band domain (kernels/warp_vjp.py). Coords are
+        if impl == "xla_banded":
+            from mine_tpu.ops import warp_banded
+            in_domain = warp_banded.guard_ok(
+                src_BCHW.shape, ys, band).astype(jnp.float32)
+            tgt = warp_banded.banded_bilinear_sample_guarded(
+                src_BCHW, xs, ys, band=band, mxu_dtype=mxu_dtype)
+        else:
+            from mine_tpu.ops import warp_separable
+            in_domain = warp_separable.guard_ok(
+                src_BCHW.shape, ys, band, sep_tol=sep_tol).astype(
+                    jnp.float32)
+            tgt = warp_separable.separable_bilinear_sample_guarded(
+                src_BCHW, xs, ys, band=band, mxu_dtype=mxu_dtype,
+                sep_tol=sep_tol)
+    elif impl in ("pallas_diff", "pallas_sep"):
+        # training paths: Pallas fwd+bwd with runtime gather fallback
+        # outside each backend's domain (kernels/warp_vjp.py — 2D band;
+        # kernels/warp_sep.py — anchor band + separability). Coords are
         # non-learnable (no-grad inverse above), so stop_gradient keeps the
         # two branches' autodiff structurally identical.
         from mine_tpu.kernels import on_tpu_backend
-        from mine_tpu.kernels.warp_vjp import bilinear_sample_diff_guarded
-        fn = functools.partial(bilinear_sample_diff_guarded,
-                               band=band,
-                               interpret=not on_tpu_backend(),
-                               mxu_dtype=mxu_dtype)
+        if impl == "pallas_diff":
+            from mine_tpu.kernels.warp_vjp import (
+                bilinear_sample_diff_guarded, guard_ok)
+            fn = functools.partial(bilinear_sample_diff_guarded,
+                                   band=band,
+                                   interpret=not on_tpu_backend(),
+                                   mxu_dtype=mxu_dtype)
+            _diff_guard_ok = functools.partial(guard_ok, band=band)
+        else:
+            from mine_tpu.kernels.warp_sep import (
+                guard_ok, separable_sample_diff_guarded)
+            fn = functools.partial(separable_sample_diff_guarded,
+                                   band=band,
+                                   interpret=not on_tpu_backend(),
+                                   mxu_dtype=mxu_dtype,
+                                   sep_tol=sep_tol)
+            _diff_guard_ok = functools.partial(guard_ok, band=band,
+                                               sep_tol=sep_tol)
         xs = jax.lax.stop_gradient(x)
         ys = jax.lax.stop_gradient(y)
-        from mine_tpu.kernels.warp_vjp import guard_ok as _diff_guard_ok
         if mesh is not None and mesh.size > 1:
             if Bp % mesh.size == 0:
                 # split the flat B' (=B*S, B-major) axis over data*plane:
@@ -242,7 +272,7 @@ def homography_warp(src_BCHW: jnp.ndarray,
                     # fast path (the old global-coords flag collapsed any
                     # single out-of-band shard to fallback=1.0 for the whole
                     # step, VERDICT r5: per-shard accounting)
-                    ok = _diff_guard_ok(s.shape, cy, band).astype(jnp.float32)
+                    ok = _diff_guard_ok(s.shape, cy).astype(jnp.float32)
                     ok = jax.lax.pmean(jax.lax.pmean(ok, DATA_AXIS),
                                        PLANE_AXIS)
                     return kernel_fn(s, cx, cy), ok
@@ -263,8 +293,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
                                    gather_dtype=mxu_dtype)
             in_domain = jnp.zeros((), jnp.float32)
         else:
-            in_domain = _diff_guard_ok(src_BCHW.shape, ys,
-                                       band).astype(jnp.float32)
+            in_domain = _diff_guard_ok(src_BCHW.shape,
+                                       ys).astype(jnp.float32)
         tgt = fn(src_BCHW, xs, ys)
     else:
         # training.warp_dtype reaches the gather too: bf16 storage halves

@@ -1,0 +1,234 @@
+"""Separable row/column banded warp in pure XLA.
+
+Fourth implementation of the homography-warp contract (reference hot op:
+grid_sample over the B*S x 7 x H x W plane volume, homography_sampler.py:138).
+The 2D banded backends (ops/warp_banded.py, kernels/warp_vjp.py) express
+bilinear resampling as ONE one-hot matmul over the whole [C*BAND, W_s] band
+per target row — every band row multiplies every output column, so MXU work
+scales with band*W_t even though at most two band rows carry nonzero weight.
+
+Per-plane homographies are translation-dominated: within one target row the
+source-row coordinate cy(i, j) is nearly constant in j (it varies with j only
+through perspective/shear terms). This module exploits that by factoring the
+2D resample into two 1D one-hot resamples:
+
+  * y pass (banded 1D matmul): per block of RT target rows, slice the same
+    [C, BAND, W_s] source band as the 2D backends, then contract it against
+    per-ROW tent weights wy[r, k] built from a scalar per-row anchor
+    y^(i) = midrange_j cy(i, j) — one [RT, BAND] @ [C, BAND, W_s] matmul per
+    block (2*C*BAND*W_s FLOPs per row);
+  * x pass (1D matmul): per target row, contract the y-resampled row
+    [C, W_s] against the EXACT per-pixel x tent weights [W_s, W_t]
+    (2*C*W_s*W_t FLOPs per row) — identical wx form to the 2D backends.
+
+dot FLOPs per target row: 2*C*W_s*(BAND + W_t) here vs 2*C*BAND*W_s*W_t for
+xla_banded — a (BAND + W_t)/(BAND*W_t) ratio, ~0.023x at the flagship shape
+(BAND=48, W_t=384), comfortably under the headline (2*BAND/W_t)x bound that
+tests/test_warp_separable.py gates on the traced jaxpr.
+
+Correctness domain (guard_ok, enforced by the lax.cond gather fallback in
+separable_bilinear_sample_guarded):
+
+  * band fit: each row-block's span of ANCHORS (not of the full 2D field)
+    plus 2 rows of bilinear support must fit the band. Within-row cy
+    variation no longer inflates the band requirement — poses whose joint
+    2D span overflows the band can still take this fast path;
+  * separability: the within-row variation instead becomes approximation
+    error. The y pass samples every column of row i at the single anchor
+    y^(i), so the value error is bounded by
+        max_j |cy(i, j) - y^(i)| * L_y,   L_y = max adjacent-row |src delta|
+    (the source's vertical Lipschitz constant under bilinear interpolation).
+    The guard admits a pose only when the anchor deviation
+    sep_err = max |cy - y^| is <= sep_tol (training.warp_sep_tol,
+    default 0.5 px — sub-pixel error even on unit-Lipschitz content).
+
+Exactness criterion (asserted in tests/test_warp_separable.py):
+  * integer translations: BITWISE equal to ops.warp.bilinear_sample — the
+    anchor is exact (cy constant per row; x+x and 0.5*x are exact in f32),
+    the tent weights are exactly {0, 1}, and zero-weight terms are exact
+    additive identities;
+  * fractional translations (either axis): within ~1 ulp (atol 2.5e-7
+    gated). Two benign f32 effects: the tent form computes the upper
+    interpolation weight as 1-(1-t) — one extra rounding vs the gather's
+    direct t — and the factorization lerps y-then-x where the gather
+    lerps x-then-y (different association). Same weight property as the
+    2D banded backends (their equivalence gates are atol 1e-5);
+  * general in-domain poses: within the sep_err * L_y bound above (gated
+    against the measured per-image bound);
+  * out-of-domain poses: the lax.cond fallback IS ops.warp.bilinear_sample,
+    so guarded output is bitwise the gather backend COMPILED THE SAME WAY
+    (compare jitted-vs-jitted; XLA's eager lerp differs from its jitted
+    lerp by ~1 ulp, which a bitwise gate must not conflate with this op).
+
+Selected with `training.warp_backend: separable` (opt-in; `auto` still
+resolves to pallas_diff/xla). kernels/warp_sep.py is the Pallas fwd+bwd
+twin of this formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mine_tpu.kernels.warp import band_start, fwd_domain_ok
+
+
+def row_anchor(coords_y_clipped: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row scalar y anchor + worst-case anchor deviation.
+
+    The anchor is the midrange 0.5*(min_j + max_j) of the row's
+    (border-clipped) source-y field — the minimax choice: it halves the
+    worst deviation vs either extreme, and it is EXACT (bitwise cy) for
+    translation poses where cy is constant along the row.
+
+    Args:
+      coords_y_clipped: [B', H_t, W_t], already clipped to [0, H_s-1]
+    Returns:
+      anchor [B', H_t] f32, sep_err scalar f32 = max |cy - anchor|
+    """
+    lo = jnp.min(coords_y_clipped, axis=2)
+    hi = jnp.max(coords_y_clipped, axis=2)
+    # 0.5*(lo+hi) is exact when lo == hi (x+x and 0.5*x are exact in f32),
+    # which is what makes translation poses bitwise
+    anchor = 0.5 * (lo + hi)
+    sep_err = 0.5 * jnp.max(hi - lo)
+    return anchor, sep_err
+
+
+@functools.partial(jax.jit, static_argnames=("band", "rows_per_block",
+                                             "mxu_dtype"))
+def separable_bilinear_sample(src: jnp.ndarray,
+                              coords_x: jnp.ndarray,
+                              coords_y: jnp.ndarray,
+                              band: int = 16,
+                              rows_per_block: int = 8,
+                              mxu_dtype=jnp.float32) -> jnp.ndarray:
+    """Separable two-pass equivalent of ops.warp.bilinear_sample (see module
+    docstring for the domain requirement and error bound).
+
+    Args:
+      src: [B', C, H_s, W_s]; coords_x/coords_y: [B', H_t, W_t]
+      mxu_dtype: contraction dtype (bfloat16 doubles MXU rate; weights AND
+        the y-resampled intermediate round at ~2^-8 relative — one more
+        value rounding than the 2D banded path — accumulation stays f32)
+    Returns: [B', C, H_t, W_t] float32
+    """
+    Bp, C, H_s, W_s = src.shape
+    _, H_t, W_t = coords_x.shape
+    RT = rows_per_block
+    assert H_t % RT == 0, (H_t, RT)
+    NB = H_t // RT
+    band = min(band, H_s)
+
+    src = src.astype(jnp.float32)
+    xc = jnp.clip(coords_x, 0.0, W_s - 1.0).astype(jnp.float32)
+    yc = jnp.clip(coords_y, 0.0, H_s - 1.0).astype(jnp.float32)
+
+    anchor, _ = row_anchor(yc)                      # [B', H_t]
+    # shared band placement rule, fed the anchor field (W_t axis of size 1):
+    # the band follows the per-row anchors, not the full 2D span
+    y0 = band_start(anchor[:, :, None], H_s, band, RT)  # [B', NB]
+
+    xs = jax.lax.broadcasted_iota(jnp.float32, (W_s, W_t), 0)   # src x pos
+    ks = jax.lax.broadcasted_iota(jnp.float32, (1, band), 1)    # band y pos
+
+    xc_blocks = xc.reshape(Bp, NB, RT, W_t)
+    anchor_blocks = anchor.reshape(Bp, NB, RT)
+
+    def slice_band(img_chw, y):
+        return jax.lax.dynamic_slice(img_chw, (0, y, 0), (C, band, W_s))
+
+    def block_step(_, nb):
+        bands = jax.vmap(slice_band)(src, y0[:, nb])  # [B', C, band, W_s]
+
+        sy = anchor_blocks[:, nb] - y0[:, nb, None].astype(jnp.float32)
+        sy = jnp.clip(sy, 0.0, band - 1.0)  # band coverage clamp
+        # [B', RT, band] one-hot y tents (<=2 nonzeros per row) -> the
+        # banded 1D y matmul: every row of the block in ONE contraction
+        wy = jnp.maximum(1.0 - jnp.abs(ks - sy[:, :, None]), 0.0)
+        tmp = jnp.einsum("brk,bcks->bcrs", wy.astype(mxu_dtype),
+                         bands.astype(mxu_dtype),
+                         preferred_element_type=jnp.float32)
+        tmp = tmp.astype(mxu_dtype)  # [B', C, RT, W_s]
+
+        def row_step(__, r):
+            sx = xc_blocks[:, nb, r]                         # [B', W_t]
+            # exact per-pixel x weights — the x pass carries ALL of the
+            # within-row coordinate variation (same wx form as warp_banded)
+            wx = jnp.maximum(1.0 - jnp.abs(xs[None] - sx[:, None, :]), 0.0)
+            out_r = jnp.einsum("bcs,bst->bct", tmp[:, :, r],
+                               wx.astype(mxu_dtype),
+                               preferred_element_type=jnp.float32)
+            return None, out_r  # [B', C, W_t]
+
+        _, rows = jax.lax.scan(row_step, None, jnp.arange(RT))
+        return None, rows  # [RT, B', C, W_t]
+
+    _, blocks = jax.lax.scan(block_step, None, jnp.arange(NB))
+    # [NB, RT, B', C, W_t] -> [B', C, NB*RT, W_t]
+    return blocks.transpose(2, 3, 0, 1, 4).reshape(Bp, C, H_t, W_t)
+
+
+def guard_ok(src_shape, coords_y, band: int = 16,
+             rows_per_block: int = 8,
+             sep_tol: float = 0.5) -> jnp.ndarray:
+    """THE fallback decision of separable_bilinear_sample_guarded, as a
+    scalar bool — exposed so diagnostics (ops/warp.homography_warp's
+    with_domain_flag) consume the same logic instead of mirroring it.
+
+    Two conditions (module docstring "correctness domain"):
+      * the per-row ANCHORS' block span fits the band (fwd_domain_ok on the
+        anchor field, aligned=False: pure-XLA band starts need no sublane
+        slack) — strictly weaker than the 2D backends' joint-span check;
+      * the anchor deviation sep_err = max |cy - y^| is <= sep_tol, keeping
+        the separability error below sep_tol * L_y.
+    """
+    H_s = src_shape[2]
+    H_t = coords_y.shape[1]
+    if H_t % rows_per_block != 0:
+        return jnp.zeros((), jnp.bool_)
+    yc = jnp.clip(coords_y, 0.0, H_s - 1.0)
+    anchor, sep_err = row_anchor(yc)
+    band_fits = fwd_domain_ok(anchor[:, :, None], H_s, band,
+                              rows_per_block, aligned=False)
+    return band_fits & (sep_err <= sep_tol)
+
+
+def separable_bilinear_sample_guarded(src, coords_x, coords_y,
+                                      band: int = 16,
+                                      rows_per_block: int = 8,
+                                      mxu_dtype=jnp.float32,
+                                      sep_tol: float = 0.5):
+    """Separable XLA warp with the runtime gather fallback.
+
+    Same guard pattern as ops/warp_banded.py: lax.cond on the pose-derived
+    domain check; both branches are XLA-differentiable, so this drops into
+    the training step directly. The fallback branch IS
+    ops.warp.bilinear_sample, so out-of-domain output is bitwise the
+    gather backend's.
+    """
+    from mine_tpu.ops.warp import bilinear_sample
+
+    # the gather fallback honors the same value dtype (bf16 storage keeps
+    # the HBM-traffic benefit when the separable path bails); both paths
+    # return f32, so the cond branches agree (f32 is a no-op knob)
+    gather_dtype = mxu_dtype
+
+    src = src.astype(jnp.float32)
+    H_t = coords_x.shape[1]
+    if H_t % rows_per_block != 0:
+        return bilinear_sample(src, coords_x, coords_y,
+                               gather_dtype=gather_dtype)
+
+    ok = guard_ok(src.shape, coords_y, band, rows_per_block, sep_tol)
+    return jax.lax.cond(
+        ok,
+        lambda s, x, y: separable_bilinear_sample(
+            s, x, y, band=band, rows_per_block=rows_per_block,
+            mxu_dtype=mxu_dtype),
+        lambda s, x, y: bilinear_sample(s, x, y, gather_dtype=gather_dtype),
+        src, coords_x, coords_y)

@@ -257,7 +257,7 @@ def loss_per_scale(scale: int,
             use_alpha=cfg.use_alpha, is_bg_depth_inf=cfg.is_bg_depth_inf,
             backend=cfg.composite_backend,
             warp_impl=cfg.warp_backend, warp_band=cfg.warp_band,
-            warp_dtype=cfg.warp_dtype,
+            warp_dtype=cfg.warp_dtype, warp_sep_tol=cfg.warp_sep_tol,
             mesh=mesh if (mesh is not None and mesh.size > 1) else None)
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = _safe_reciprocal_depth(res.depth)
@@ -370,7 +370,8 @@ def loss_per_scale(scale: int,
         "psnr_tgt": psnr_tgt,
         "loss_disp_pt3dtgt": loss_disp_tgt,
     }
-    if cfg.warp_backend in ("pallas_diff", "xla_banded"):
+    if cfg.warp_backend in ("pallas_diff", "xla_banded",
+                            "separable", "pallas_sep"):
         # guard diagnostic, not a loss: 1.0 when this scale's guarded warp
         # backend bailed to the gather (key absent on unguarded backends)
         loss_dict["warp_fallback"] = jax.lax.stop_gradient(

@@ -66,6 +66,8 @@ QUICK = {
     "test_train.py::test_multistep_lr_schedule",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
+    "test_warp_separable.py::test_integer_translation_bitwise",
+    "test_warp_guard_domain.py::test_flag_nan_for_unguarded_backend",
     "test_warp_kernel.py::test_band_span_helper",
     "test_warp_vjp.py::test_domain_check_classifies",
     "test_quick_tier.py::test_quick_entries_point_at_existing_tests",
@@ -109,8 +111,30 @@ def pytest_configure(config):
                    "(~8-10 min; excludes slow-marked tests)")
 
 
+# Trainer-compile integration suites: each test jits one or two FULL train
+# steps (30-120 s apiece on the 1-core CI box). They run LAST so a
+# wall-clock-capped tier-1 window (ROADMAP's `timeout 870` line) truncates
+# into the fewest, slowest tests instead of axing whole cheap suites that
+# happen to sort after 't' — the dot count then degrades by ~1 per lost
+# minute at the tail rather than ~10. Order within each group stays
+# alphabetical (deterministic; `-p no:randomly` is part of the contract).
+HEAVY_LAST_FILES = (
+    "test_fused_loss.py",
+    "test_checkpoint.py",
+    "test_pipeline.py",
+    "test_first_real_run.py",
+    "test_train_loop.py",
+    "test_plane_scan.py",
+    "test_train.py",
+    "test_train_variants.py",
+)
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest  # local: conftest imports before pytest plugins
+    order = {f: i for i, f in enumerate(HEAVY_LAST_FILES)}
+    items.sort(key=lambda it: order.get(
+        os.path.basename(it.nodeid.partition("::")[0]), -1))
     for item in items:
         # nodeid is like "tests/test_x.py::test_y[param]". A QUICK entry
         # naming the bare test marks EVERY parametrization (keep such tests

@@ -128,6 +128,22 @@ def test_trainer_accepts_xla_banded():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_repro_tool_minimal_stages_pass():
+    """tools/repro_banded_compile.py (the staged r5 compile-crash repro)
+    must stay runnable: stages 1-3 at toy shapes on CPU. Its --full stage
+    is this file's trainer test in tool form — not re-compiled here."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import repro_banded_compile
+    with pytest.raises(SystemExit) as e:
+        repro_banded_compile.main(["--height", "32", "--width", "48",
+                                   "--planes", "2", "--batch", "1",
+                                   "--band", "8"])
+    assert e.value.code == 0
+
+
 def test_homography_warp_domain_flag_tracks_guard():
     """with_domain_flag (the warp_fallback_frac metric's source) reports the
     guarded backends' actual fallback decision: 1.0 for a translation-only

@@ -1,0 +1,154 @@
+"""Guard-domain property tests across ALL guarded warp backends + meshes.
+
+The `warp_fallback_frac` training metric is only trustworthy if the
+with_domain_flag plumbing reports each backend's ACTUAL lax.cond decision —
+not a lookalike recomputation. Property: for every guarded backend
+(xla_banded / separable / pallas_diff / pallas_sep) and every mesh shape
+(single device, 2- and 4-device data meshes), the flag equals EXACTLY the
+fraction of shards whose own guard_ok passes — 1.0 on randomized
+translation-dominated poses, 0.0 on an adversarial rotation-heavy one,
+with the expectation derived by replaying the homography math and calling
+the backend's exported guard_ok directly (ops/warp.py builds the flag from
+that same function, so a drift between cond and flag is what this catches).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mine_tpu import geometry
+from mine_tpu.kernels import warp_sep as kernels_warp_sep
+from mine_tpu.kernels import warp_vjp
+from mine_tpu.ops import warp_banded, warp_separable
+from mine_tpu.ops.warp import homography_warp
+from mine_tpu.parallel import mesh as mesh_lib
+
+B, C, H, W = 8, 3, 32, 32
+
+# (impl, band, guard_ok(src_shape, coords_y)); bands: 16 for the pure-XLA
+# guards, 24 for the Pallas ones (their aligned=True domain budgets the
+# SUBLANE_ALIGN-1 slack)
+BACKENDS = [
+    ("xla_banded", 16,
+     functools.partial(warp_banded.guard_ok, band=16)),
+    ("separable", 16,
+     functools.partial(warp_separable.guard_ok, band=16, sep_tol=0.5)),
+    ("pallas_diff", 24,
+     functools.partial(warp_vjp.guard_ok, band=24)),
+    ("pallas_sep", 24,
+     functools.partial(kernels_warp_sep.guard_ok, band=24, sep_tol=0.5)),
+]
+
+
+def _setup(seed=7):
+    src = jax.random.uniform(jax.random.PRNGKey(seed), (B, C, H, W))
+    d = jnp.linspace(1.0, 4.0, B)
+    K = jnp.asarray(geometry.intrinsics_from_fov(H, W, 60.0))[None].repeat(B, 0)
+    K_inv = geometry.inverse_intrinsics(K)
+    grid = geometry.cached_pixel_grid(H, W)
+    return src, d, K, K_inv, grid
+
+
+def _translation_pose(seed):
+    """Translation-dominated pose: small random t, no rotation."""
+    rng = np.random.RandomState(seed)
+    G = jnp.eye(4)[None].repeat(B, 0)
+    t = rng.uniform(-0.05, 0.05, size=(B, 3)).astype(np.float32)
+    return G.at[:, 0:3, 3].set(jnp.asarray(t))
+
+
+def _adversarial_pose():
+    """Strong in-plane rotation: source rows sweep the image, every
+    row-block's span blows any practical band on every shard."""
+    a = 0.6
+    R = jnp.asarray([[np.cos(a), -np.sin(a), 0.0, 0.0],
+                     [np.sin(a), np.cos(a), 0.0, 0.0],
+                     [0.0, 0.0, 1.0, 0.0],
+                     [0.0, 0.0, 0.0, 1.0]], jnp.float32)
+    return jnp.broadcast_to(R, (B, 4, 4))
+
+
+def _source_rows(d, G, K_inv, K, grid):
+    """Replay homography_warp's coordinate derivation (ops/warp.py) to feed
+    the guard the exact same source-y field the backend sees."""
+    H_tgt_src = geometry.homography_tgt_src(K, K_inv, G, d)
+    H_src_tgt = geometry.inverse_3x3(H_tgt_src)
+    g = grid.reshape(3, H * W)
+    src_homo = jnp.einsum("bij,jn->bin", H_src_tgt, g)
+    src_xy = src_homo[:, 0:2, :] / src_homo[:, 2:3, :]
+    return src_xy[:, 1, :].reshape(B, H, W)
+
+
+def _expected_flag(impl, guard, cy, mesh):
+    """The flag contract: Pallas backends on a multi-device mesh decide the
+    cond PER SHARD and pmean the guards; everything else decides globally."""
+    if impl in ("pallas_diff", "pallas_sep") and mesh is not None \
+            and mesh.size > 1:
+        shards = np.split(np.asarray(cy), mesh.size, axis=0)
+        per = [float(guard((B // mesh.size, C, H, W), jnp.asarray(s)))
+               for s in shards]
+        return float(np.mean(per))
+    return float(guard((B, C, H, W), cy))
+
+
+def _mesh(n):
+    if n is None:
+        return None
+    return mesh_lib.make_mesh(data=n, plane=1, devices=jax.devices()[:n])
+
+
+@pytest.mark.parametrize("impl,band,guard",
+                         BACKENDS, ids=[b[0] for b in BACKENDS])
+@pytest.mark.parametrize("mesh_n", [None, 2, 4])
+def test_flag_matches_guard(impl, band, guard, mesh_n):
+    src, d, K, K_inv, grid = _setup()
+    mesh = _mesh(mesh_n)
+    # seed sweep only single-device: the mesh cases re-check the SAME guard
+    # math per shard, so one in-band pose + the adversarial one suffice
+    # (interpret-mode Pallas on CPU makes each mesh eval expensive)
+    seeds = (0, 1, 2) if mesh_n is None else (0,)
+    poses = [("trans%d" % s, _translation_pose(s), 1.0) for s in seeds]
+    poses.append(("rot", _adversarial_pose(), 0.0))
+    for name, G, want in poses:
+        cy = _source_rows(d, G, K_inv, K, grid)
+        expected = _expected_flag(impl, guard, cy, mesh)
+        # the constructed poses are unambiguous: fully in-band or fully out
+        assert expected == want, (impl, mesh_n, name, expected)
+        _, _, flag = homography_warp(src, d, G, K_inv, K, grid, impl=impl,
+                                     band=band, mesh=mesh,
+                                     with_domain_flag=True)
+        assert float(flag) == expected, (impl, mesh_n, name, float(flag))
+
+
+def test_flag_partial_fallback_on_mixed_shards():
+    """A mesh where ONE of two shards draws an out-of-band pose must report
+    the fraction (0.5), not collapse to all-or-nothing — the per-shard
+    accounting the r6 flag rework introduced, now pinned for the separable
+    Pallas backend too."""
+    src, d, K, K_inv, grid = _setup()
+    mesh = _mesh(2)
+    G = _translation_pose(0)
+    # second half of the batch (shard 1 under P(("data","plane"))): rotation
+    G = G.at[B // 2:].set(_adversarial_pose()[B // 2:])
+    for impl, band, guard in BACKENDS:
+        if impl in ("xla_banded", "separable"):
+            continue  # no shard_map path: the guard is global by design
+        cy = _source_rows(d, G, K_inv, K, grid)
+        expected = _expected_flag(impl, guard, cy, mesh)
+        assert expected == 0.5, (impl, expected)
+        _, _, flag = homography_warp(src, d, G, K_inv, K, grid, impl=impl,
+                                     band=band, mesh=mesh,
+                                     with_domain_flag=True)
+        assert float(flag) == 0.5, (impl, float(flag))
+
+
+def test_flag_nan_for_unguarded_backend():
+    """Plain xla has no runtime guard: the flag must be NaN, never a fake
+    0.0/1.0 that would pollute the warp_fallback_frac metric."""
+    src, d, K, K_inv, grid = _setup()
+    _, _, flag = homography_warp(src, d, _translation_pose(0), K_inv, K, grid,
+                                 impl="xla", with_domain_flag=True)
+    assert np.isnan(float(flag))

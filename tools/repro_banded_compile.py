@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Standalone repro of the r5 xla_banded FULL-STEP compile crash.
+
+The round-5 bisect (BENCH_NOTES_r05.md) left the xla_banded warp backend in
+a strange place: the guarded banded op compiles AND runs standalone on the
+TPU toolchain at every shape the train step uses (fwd 38 s, grad 43 s, all
+four loss scales), yet ANY full train step containing it crashes the remote
+compiler server-side — "remote_compile: HTTP 500: tpu_compile_helper
+subprocess exit code 1" — at both resnet50 and resnet18 depth. The failure
+is compositional, and no server logs are reachable from this container.
+
+This script is the smallest graph we can hand a toolchain owner, staged so
+a partial pass keeps bisecting:
+
+  1. op fwd        — guarded banded warp alone (passed on r5 toolchain)
+  2. op grad       — value_and_grad of the op (passed on r5 toolchain)
+  3. composed      — conv -> guarded banded warp -> scalar loss, jitted as
+                     value_and_grad over BOTH the conv weights and the
+                     volume: the minimal train-step-shaped composition
+                     (differentiated convolution + the lax.cond'd one-hot
+                     matmul + fused backward) without the model zoo
+  4. --full        — the real SynthesisTrainer jitted step with
+                     training.warp_backend=xla_banded (the known crasher)
+
+Each stage prints timing + OK or the exception; exit 1 if any stage fails.
+On CPU all stages pass (tier-1 CI keeps it that way at toy shapes) — the
+point of the file is to run it where the crash lives:
+
+    python tools/repro_banded_compile.py                     # stages 1-3
+    python tools/repro_banded_compile.py --full              # + real step
+    python tools/repro_banded_compile.py --height 64 --width 96 --planes 4
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _coords(B, H, W, shift=2.3, shear=0.02):
+    """Translation-dominated field that stays INSIDE the band guard — the
+    crash must exercise the banded cond branch, not the gather fallback."""
+    import jax.numpy as jnp
+    yy, xx = jnp.meshgrid(jnp.arange(H, dtype=jnp.float32),
+                          jnp.arange(W, dtype=jnp.float32), indexing="ij")
+    cx = jnp.broadcast_to(xx + shift + shear * yy, (B, H, W))
+    cy = jnp.broadcast_to(yy + shift + shear * xx, (B, H, W))
+    return cx, cy
+
+
+def _stage(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+    except Exception as e:
+        msg = (str(e).splitlines() or [repr(e)])[0][:300]
+        print("stage %-10s FAIL after %.1fs: %s" % (name, time.time() - t0,
+                                                    msg))
+        return False
+    print("stage %-10s OK (%.1fs)" % (name, time.time() - t0))
+    return True
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--height", type=int, default=256)
+    p.add_argument("--width", type=int, default=384)
+    p.add_argument("--planes", type=int, default=32)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--band", type=int, default=48)
+    p.add_argument("--layers", type=int, default=18,
+                   help="--full backbone depth (18 reproduced the crash "
+                        "as reliably as 50 and compiles much faster)")
+    p.add_argument("--full", action="store_true",
+                   help="also compile+run the real jitted train step with "
+                        "training.warp_backend=xla_banded")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.ops.warp_banded import banded_bilinear_sample_guarded
+
+    print("jax %s, backend %s, devices %s"
+          % (jax.__version__, jax.default_backend(),
+             [d.platform for d in jax.devices()]))
+
+    Bp = args.batch * args.planes
+    C, H, W = 7, args.height, args.width
+    key = jax.random.PRNGKey(0)
+    vol = jax.random.uniform(key, (Bp, C, H, W), jnp.float32)
+    cx, cy = _coords(Bp, H, W)
+
+    def warp(v):
+        return banded_bilinear_sample_guarded(v, cx, cy, band=args.band)
+
+    def run_fwd():
+        jax.block_until_ready(jax.jit(warp).lower(vol).compile()(vol))
+
+    def run_grad():
+        g = jax.jit(jax.grad(lambda v: jnp.mean(warp(v) ** 2)))
+        jax.block_until_ready(g.lower(vol).compile()(vol))
+
+    w = jax.random.normal(jax.random.PRNGKey(1), (C, C, 3, 3),
+                          jnp.float32) * 0.1
+
+    def composed_loss(w_, v):
+        feat = jax.lax.conv_general_dilated(
+            v, w_, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return jnp.mean(warp(feat) ** 2)
+
+    def run_composed():
+        g = jax.jit(jax.value_and_grad(composed_loss, argnums=(0, 1)))
+        jax.block_until_ready(g.lower(w, vol).compile()(w, vol))
+
+    ok = _stage("op-fwd", run_fwd)
+    ok = _stage("op-grad", run_grad) and ok
+    ok = _stage("composed", run_composed) and ok
+
+    if args.full:
+        def run_full():
+            from mine_tpu.config import CONFIG_DIR, load_config
+            from mine_tpu.data.synthetic import make_batch
+            from mine_tpu.train.step import SynthesisTrainer
+            config = load_config(os.path.join(CONFIG_DIR,
+                                              "params_llff.yaml"))
+            config.update({
+                "data.img_h": args.height, "data.img_w": args.width,
+                "mpi.num_bins_coarse": args.planes,
+                "model.num_layers": args.layers,
+                "data.per_gpu_batch_size": args.batch,
+                "training.warp_backend": "xla_banded",
+                "training.warp_band": args.band,
+            })
+            trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
+            state = trainer.init_state(batch_size=args.batch)
+            batch = {k: jnp.asarray(v) for k, v in
+                     make_batch(args.batch, args.height, args.width,
+                                num_points=256).items()}
+            step = trainer._train_step.lower(state, batch).compile()
+            _, metrics = step(state, batch)
+            jax.block_until_ready(metrics)
+
+        ok = _stage("full-step", run_full) and ok
+
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

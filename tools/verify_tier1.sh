@@ -1,29 +1,76 @@
 #!/usr/bin/env bash
 # Canonical tier-1 verification — the EXACT pytest line from ROADMAP.md
-# ("Tier-1 verify"), wrapped so builders and CI run one command and get a
-# pass-count delta against the checked-in baseline instead of eyeballing
-# dots. Exit code is the pytest exit code; the DOTS_PASSED line at the end
-# is the number the ROADMAP contract compares.
+# ("Tier-1 verify") plus -rX, wrapped so builders and CI run one command
+# and get a pass-count delta against the checked-in baseline instead of
+# eyeballing dots. Exit code is the pytest exit code; the DOTS_PASSED line
+# at the end is the number the ROADMAP contract compares.
 #
-# Usage: tools/verify_tier1.sh
-# Baseline: tools/tier1_baseline.txt (update it in the same commit as any
-# intentional test-count change, with a line in CHANGES.md saying why).
+# Usage: tools/verify_tier1.sh [--update-baseline]
+#   --update-baseline  on a GREEN run (pytest rc=0, no regression, no
+#                      XPASS) write the measured pass count to
+#                      tools/tier1_baseline.txt — the sanctioned way to
+#                      bump the baseline in the same commit as an
+#                      intentional test-count change (with a CHANGES.md
+#                      line saying why). Never writes on a red run.
+# Baseline: tools/tier1_baseline.txt.
+#
+# XPASS policy: the suite carries strict=False xfails documenting a real
+# environment bug — the 8-device GSPMD CPU-mesh numeric divergence. Two of
+# them (test_plane_scan.py::test_train_step_plane_scan_matches_xla and
+# test_train.py::test_train_step_pallas_backends_on_mesh) NEVER pass on
+# the broken partitioner, so their XPASS means the environment changed
+# under us (e.g. a jax upgrade fixed the divergence) and all four 8-device
+# xfails must be retired — that XPASS fails THIS wrapper loudly instead of
+# vanishing into the dot stream. The other two (the sharded train/eval
+# parity tests in test_train.py) xpass nondeterministically — the drift
+# ranges 0.4%-4x across processes on the SAME build — so their XPASS is
+# reported but does not redden the run.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
+UPDATE_BASELINE=0
+[ "${1:-}" = "--update-baseline" ] && UPDATE_BASELINE=1
+
 LOG=/tmp/_t1.log
 rm -f "$LOG"
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -rX \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 
-passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+# 'X' (xpass) joins the dot classes so an xpassing line can't silently
+# swallow its neighbors' dots from the count
+passed=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+xpassed=$(grep -aoE '[0-9]+ xpassed' "$LOG" | tail -1 | grep -oE '[0-9]+')
+xpassed=${xpassed:-0}
 baseline=$(cat tools/tier1_baseline.txt 2>/dev/null || echo 0)
 delta=$((passed - baseline))
 echo "DOTS_PASSED=$passed (baseline $baseline, delta ${delta#+})"
 if [ "$passed" -lt "$baseline" ]; then
     echo "REGRESSION: tier-1 pass count dropped below the checked-in baseline"
     [ "$rc" -eq 0 ] && rc=1
+fi
+if [ "$xpassed" -gt 0 ]; then
+    grep -a '^XPASS' "$LOG"
+    if grep -a '^XPASS' "$LOG" | grep -qE \
+        'test_train_step_plane_scan_matches_xla|test_train_step_pallas_backends_on_mesh'
+    then
+        echo "XPASS: a never-passing 8-device GSPMD divergence xfail now"
+        echo "passes — the environment changed: retire all four 8-device"
+        echo "xfail markers (test_plane_scan.py, test_train.py) in the same"
+        echo "commit."
+        [ "$rc" -eq 0 ] && rc=1
+    else
+        echo "XPASS: nondeterministic 8-device parity xfail(s) passed this"
+        echo "run — expected on the broken partitioner, not a failure."
+    fi
+fi
+if [ "$UPDATE_BASELINE" -eq 1 ]; then
+    if [ "$rc" -eq 0 ]; then
+        echo "$passed" > tools/tier1_baseline.txt
+        echo "BASELINE_UPDATED: tools/tier1_baseline.txt = $passed"
+    else
+        echo "BASELINE_NOT_UPDATED: run was not green (rc=$rc)"
+    fi
 fi
 exit "$rc"

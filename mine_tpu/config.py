@@ -156,6 +156,48 @@ class MPIConfig:
         return self.num_bins_coarse + self.num_bins_fine
 
 
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (train/resilience.py; README "Fault
+    tolerance"). All host-side policy — nothing here changes the numerics
+    of a healthy run."""
+    # training.guard_nonfinite: all-finite check over loss + global
+    # grad-norm inside the jitted step; a poisoned step becomes a
+    # zero-update (step still increments)
+    guard_nonfinite: bool = True
+    # training.guard_skip_threshold: abort after this many CONSECUTIVE
+    # skipped steps (<=0: never abort, keep skipping)
+    guard_skip_threshold: int = 25
+    # training.checkpoint_keep: retain only the newest K step checkpoints
+    # (0 = keep all)
+    checkpoint_keep: int = 0
+    # data.max_item_retries / data.item_retry_backoff: bounded per-item
+    # load retry before deterministic quarantine-and-replace
+    max_item_retries: int = 2
+    item_retry_backoff: float = 0.05
+
+
+def resilience_config_from_dict(config: Dict[str, Any]) -> ResilienceConfig:
+    g = config.get
+    out = ResilienceConfig(
+        guard_nonfinite=bool(g("training.guard_nonfinite", True)),
+        guard_skip_threshold=int(g("training.guard_skip_threshold", 25)),
+        checkpoint_keep=int(g("training.checkpoint_keep", 0) or 0),
+        max_item_retries=int(g("data.max_item_retries", 2)),
+        item_retry_backoff=float(g("data.item_retry_backoff", 0.05)),
+    )
+    if out.checkpoint_keep < 0:
+        raise ValueError(
+            f"training.checkpoint_keep must be >= 0, got {out.checkpoint_keep}")
+    if out.max_item_retries < 0:
+        raise ValueError(
+            f"data.max_item_retries must be >= 0, got {out.max_item_retries}")
+    if out.item_retry_backoff < 0:
+        raise ValueError(f"data.item_retry_backoff must be >= 0, "
+                         f"got {out.item_retry_backoff}")
+    return out
+
+
 # Datasets for which the sparse-3D-point disparity loss and scale factor are
 # disabled (reference: synthesis_task.py:213-214,297).
 _NO_DISP_DATASETS = ("flowers", "kitti_raw", "dtu")

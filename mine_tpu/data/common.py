@@ -19,9 +19,81 @@ items in consumption order, which serializes assembly by construction.)
 
 from __future__ import annotations
 
+import dataclasses
+import logging
+import threading
+import time
 from typing import Callable, Dict, Iterator, List, Tuple
 
 import numpy as np
+
+from mine_tpu.testing import faults
+
+
+# ---------------- degradation policy + counters ----------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded per-item retry (data.max_item_retries /
+    data.item_retry_backoff): a transient decode/IO failure is retried
+    with a fresh-but-identical PRNG stream (so a healed retry yields the
+    exact bytes an unfailed load would have), then the item is quarantined
+    and deterministically replaced."""
+    max_item_retries: int = 2
+    backoff_s: float = 0.05
+
+
+_retry_policy = RetryPolicy()
+
+
+def set_retry_policy(policy: RetryPolicy):
+    global _retry_policy
+    _retry_policy = policy
+
+
+def get_retry_policy() -> RetryPolicy:
+    return _retry_policy
+
+
+class _PipelineStats:
+    """Process-wide data-degradation counters, surfaced through the train
+    loop's step-time log line (`data_errors`). Thread-safe: assembler
+    workers bump them concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.data_errors = 0       # failed item-load attempts
+            self.quarantined = set()   # dataset indices proven persistently bad
+            self.worker_respawns = 0
+
+    def record_error(self, n: int = 1):
+        with self._lock:
+            self.data_errors += n
+
+    def record_quarantine(self, index: int):
+        with self._lock:
+            self.quarantined.add(int(index))
+
+    def is_quarantined(self, index: int) -> bool:
+        with self._lock:
+            return int(index) in self.quarantined
+
+    def record_respawn(self):
+        with self._lock:
+            self.worker_respawns += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"data_errors": self.data_errors,
+                    "quarantined": len(self.quarantined),
+                    "worker_respawns": self.worker_respawns}
+
+
+PIPELINE_STATS = _PipelineStats()
 
 
 def _mix64(x: int) -> int:
@@ -63,6 +135,55 @@ def num_batches(num_items: int, batch_size: int, drop_last: bool) -> int:
     return -(-num_items // batch_size)
 
 
+def load_item(get_pair: Callable[[int, np.random.RandomState],
+                                 Tuple[Dict, Dict]],
+              order: np.ndarray,
+              position: int,
+              seed: int,
+              epoch: int) -> Tuple[Dict, Dict]:
+    """Load shard-order slot `position` with bounded retry, then
+    deterministic quarantine-and-replace.
+
+    Retries rebuild item_rng from scratch each attempt, so a transient
+    failure that heals produces bytes identical to a run that never
+    failed. A persistently-bad item (all retries exhausted) is quarantined
+    and replaced by the next non-bad dataset index in shard order —
+    `order[(position + k) % len(order)]`, probed with the SAME rng stream
+    (still keyed to the original position): the replacement depends only
+    on (order, position) and which items are persistently bad, never on
+    worker count or assembly timing, so batches stay bitwise-deterministic.
+    The quarantine set is a cost memo (skip the doomed retries when the
+    same index comes around again), not an input to the result.
+    """
+    policy = _retry_policy
+    n = len(order)
+    last_err: Exception = None
+    for k in range(n):
+        idx = int(order[(position + k) % n])
+        if k > 0 and PIPELINE_STATS.is_quarantined(idx):
+            continue
+        for attempt in range(policy.max_item_retries + 1):
+            try:
+                faults.on_item_load(idx)
+                pair = get_pair(idx, item_rng(seed, epoch, position))
+            except Exception as e:
+                last_err = e
+                PIPELINE_STATS.record_error()
+                if attempt < policy.max_item_retries:
+                    time.sleep(policy.backoff_s * (2 ** attempt))
+                continue
+            if k > 0:
+                logging.getLogger(__name__).warning(
+                    "item %d (slot %d) quarantined after %d attempts — "
+                    "substituting item %d: %s", int(order[position]),
+                    position, policy.max_item_retries + 1, idx, last_err)
+            return pair
+        PIPELINE_STATS.record_quarantine(idx)
+    raise RuntimeError(
+        f"every candidate item for slot {position} failed "
+        f"(dataset unusable); last error: {last_err!r}") from last_err
+
+
 def assemble_batch(get_pair: Callable[[int, np.random.RandomState],
                                       Tuple[Dict, Dict]],
                    order: np.ndarray,
@@ -73,12 +194,14 @@ def assemble_batch(get_pair: Callable[[int, np.random.RandomState],
     """Assemble + collate batch `batch_index` of the shard order.
 
     Pure in (order, batch_index, seed, epoch): any worker can build any
-    batch, in any order, and get the same bytes.
+    batch, in any order, and get the same bytes. Item loads go through
+    `load_item` (bounded retry + deterministic quarantine), so one bad
+    example degrades the batch, not the epoch.
     """
     lo = batch_index * batch_size
     idxs = order[lo:lo + batch_size]
-    pairs = [get_pair(int(idx), item_rng(seed, epoch, lo + j))
-             for j, idx in enumerate(idxs)]
+    pairs = [load_item(get_pair, order, lo + j, seed, epoch)
+             for j in range(len(idxs))]
     return collate_pairs(pairs)
 
 

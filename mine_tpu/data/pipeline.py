@@ -110,15 +110,23 @@ def threaded_pair_batches(num_items: int,
     credit semaphore the consumer refills. A worker exception is re-raised
     on the consumer at the failing batch's position; abandoning the
     generator stops the pool promptly.
+
+    A worker that DIES (thread killed by a non-Exception, e.g. the chaos
+    suite's WorkerKill) does not end the epoch: its claimed batch is
+    requeued for the surviving workers, and when the whole pool is dead
+    the consumer respawns it (bounded budget, counted in
+    common.PIPELINE_STATS.worker_respawns) instead of raising.
     """
     order = common.shard_order(num_items, shuffle, seed, epoch, shard_index,
                                num_shards)
     nb = common.num_batches(len(order), batch_size, drop_last)
 
+    pool_size = max(1, workers)
     credits = threading.Semaphore(max(workers, prefetch_batches, 1))
     cv = threading.Condition()
     results: Dict[int, Dict] = {}
     errors = []
+    requeue = []  # batch indices whose claiming worker died mid-assembly
     next_batch = [0]  # next index to hand to a worker
     stop = threading.Event()
 
@@ -127,28 +135,45 @@ def threaded_pair_batches(num_items: int,
             if not credits.acquire(timeout=0.1):
                 continue
             with cv:
-                if next_batch[0] >= nb or errors:
+                if errors or (next_batch[0] >= nb and not requeue):
                     credits.release()
                     return
-                b = next_batch[0]
-                next_batch[0] += 1
+                if requeue:
+                    b = requeue.pop()
+                else:
+                    b = next_batch[0]
+                    next_batch[0] += 1
             try:
                 batch = common.assemble_batch(get_pair, order, b, batch_size,
                                               seed, epoch)
-            except BaseException as e:
+            except Exception as e:
                 with cv:
                     errors.append((b, e))
                     cv.notify_all()
+                return
+            except BaseException:
+                # the thread is dying (injected kill / interpreter teardown):
+                # hand the claimed batch back so the pool can finish it
+                with cv:
+                    requeue.append(b)
+                    cv.notify_all()
+                credits.release()
                 return
             with cv:
                 results[b] = batch
                 cv.notify_all()
 
-    threads = [threading.Thread(target=worker, daemon=True,
-                                name="mine-tpu-assembler-%d" % i)
-               for i in range(max(1, workers))]
-    for t in threads:
+    def spawn(i):
+        t = threading.Thread(target=worker, daemon=True,
+                             name="mine-tpu-assembler-%d" % i)
         t.start()
+        return t
+
+    threads = [spawn(i) for i in range(pool_size)]
+    # a dead pool is respawned rather than fatal, but boundedly — a pool
+    # that keeps dying (systemic failure, not one bad worker) must still
+    # surface instead of flapping forever
+    respawn_budget = 3 * pool_size
     try:
         for b in range(nb):
             with cv:
@@ -160,6 +185,13 @@ def threaded_pair_batches(num_items: int,
                         raise pending_err[0]
                     if not any(t.is_alive() for t in threads) \
                             and b not in results:
+                        if respawn_budget > 0 and not errors:
+                            respawn_budget -= 1
+                            common.PIPELINE_STATS.record_respawn()
+                            threads = [t for t in threads if t.is_alive()]
+                            threads.append(spawn(3 * pool_size
+                                                 - respawn_budget))
+                            continue
                         raise RuntimeError(
                             "assembler workers died without producing "
                             "batch %d" % b)

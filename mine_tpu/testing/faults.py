@@ -1,0 +1,182 @@
+"""Config/env-driven fault injection — the chaos-test seams.
+
+Production TPU runs die to preemption, transient data corruption, and
+numeric blow-ups; the resilience layer (train/resilience.py, the step
+guard in train/step.py, the checkpoint fallback chain, the pipeline
+retry/respawn paths) exists to absorb those. This module injects each
+failure mode on demand so the chaos suite (tests/test_chaos.py,
+tools/chaos_soak.py) can drive the recovery paths end-to-end:
+
+  * ``nan_grads_at_step`` / ``nan_grads_from_step`` — poison the
+    gradients inside the jitted train step (consulted at TRACE time by
+    SynthesisTrainer, so the injection itself costs no host sync).
+  * ``sigterm_at_step`` — deliver SIGTERM to our own pid when the host
+    loop reaches that global step (exercises the preemption-safe
+    shutdown: flag -> all-host agreement -> emergency checkpoint).
+  * ``item_raise_index`` / ``item_raise_times`` — a dataset item whose
+    load raises; times=k makes it transient (first k loads fail, then
+    heal — the retry path), times=-1 makes it persistent (the
+    quarantine path).
+  * ``kill_worker_at_call`` — the nth item load (1-based, counted
+    across all workers) raises WorkerKill, a BaseException that skips
+    the per-item retry/quarantine machinery and kills the assembler
+    thread outright (the worker-respawn path).
+
+The plan comes from ``set_plan`` (tests), the MINE_TPU_FAULTS env var
+(subprocess legs of the chaos soak), or a config's ``testing.fault_plan``
+JSON (train_cli). With no plan active every hook is a cheap no-op, so the
+seams can stay in the production paths permanently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+from typing import Dict, Optional
+
+ENV_VAR = "MINE_TPU_FAULTS"
+
+
+class WorkerKill(BaseException):
+    """Kills an assembler worker thread outright (not an Exception, so the
+    per-item retry and the worker's error-recording handler both pass it
+    through) — simulates a worker dying mid-assembly."""
+
+
+class InjectedItemError(ValueError):
+    """The injected per-item load failure (transient or persistent)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """-1 disables a fault everywhere below."""
+    nan_grads_at_step: int = -1    # poison grads at exactly this state.step
+    nan_grads_from_step: int = -1  # poison grads at every state.step >= this
+    sigterm_at_step: int = -1      # SIGTERM own pid at this host global step
+    item_raise_index: int = -1     # dataset index whose load raises
+    item_raise_times: int = -1     # -1: always; k>0: first k loads only
+    kill_worker_at_call: int = -1  # nth item load (1-based) dies WorkerKill
+
+    @property
+    def active(self) -> bool:
+        return any(v != -1 for v in dataclasses.asdict(self).values())
+
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_counts: Dict[str, int] = {}
+
+
+def set_plan(plan: Optional[FaultPlan]):
+    """Install (or clear, with None) the active plan; resets fault counters."""
+    global _plan
+    with _lock:
+        _plan = plan if (plan is not None and plan.active) else None
+        _counts.clear()
+
+
+def get_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def plan_from_env(environ=None) -> Optional[FaultPlan]:
+    """MINE_TPU_FAULTS='{"sigterm_at_step": 5, ...}' -> FaultPlan."""
+    raw = (environ or os.environ).get(ENV_VAR, "")
+    if not raw:
+        return None
+    return plan_from_spec(json.loads(raw))
+
+
+def plan_from_spec(spec) -> Optional[FaultPlan]:
+    """dict or JSON string -> FaultPlan; unknown keys raise (typo guard)."""
+    if spec in (None, "", {}):
+        return None
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    known = {f.name for f in dataclasses.fields(FaultPlan)}
+    unknown = set(spec) - known
+    if unknown:
+        raise KeyError(f"unknown fault plan keys: {sorted(unknown)} "
+                       f"(known: {sorted(known)})")
+    return FaultPlan(**{k: int(v) for k, v in spec.items()})
+
+
+def activate(config=None):
+    """Install the env plan (wins) or the config's testing.fault_plan."""
+    plan = plan_from_env()
+    if plan is None and config is not None:
+        plan = plan_from_spec(config.get("testing.fault_plan"))
+    if plan is not None:
+        set_plan(plan)
+    return plan
+
+
+# ---------------- hooks (no-ops without an active plan) ----------------
+
+def on_item_load(index: int):
+    """Called by data/common.load_item before every get_pair. Raises per
+    plan; the global call counter feeds kill_worker_at_call."""
+    plan = _plan
+    if plan is None:
+        return
+    with _lock:
+        call = _counts.get("item_calls", 0) + 1
+        _counts["item_calls"] = call
+        if call == plan.kill_worker_at_call:
+            raise WorkerKill(f"injected worker kill at item load #{call}")
+        if index == plan.item_raise_index:
+            seen = _counts.get("item_fails", 0)
+            if plan.item_raise_times < 0 or seen < plan.item_raise_times:
+                _counts["item_fails"] = seen + 1
+                raise InjectedItemError(
+                    f"injected load failure for item {index} "
+                    f"(occurrence {seen + 1})")
+
+
+def nan_grad_window() -> Optional[tuple]:
+    """(at_step, from_step) for the trainer's trace-time injection, or None.
+    Read once at SynthesisTrainer construction — set the plan BEFORE
+    building the trainer."""
+    plan = _plan
+    if plan is None:
+        return None
+    if plan.nan_grads_at_step < 0 and plan.nan_grads_from_step < 0:
+        return None
+    return (plan.nan_grads_at_step, plan.nan_grads_from_step)
+
+
+def maybe_sigterm(gstep: int):
+    """Host-loop hook: deliver SIGTERM to our own pid once when gstep
+    reaches the planned step (the preemption drill)."""
+    plan = _plan
+    if plan is None or plan.sigterm_at_step < 0:
+        return
+    with _lock:
+        if gstep >= plan.sigterm_at_step and not _counts.get("sigterm_sent"):
+            _counts["sigterm_sent"] = 1
+        else:
+            return
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+# ---------------- checkpoint corruption (test/soak helper) ----------------
+
+def truncate_checkpoint(path: str, keep_files: int = 1):
+    """Corrupt a checkpoint directory the way a mid-write crash does: keep
+    the first `keep_files` entries (sorted), truncate one survivor to half
+    its bytes, delete the rest. Works on the nested orbax layout."""
+    entries = []
+    for root, _, files in os.walk(path):
+        entries.extend(os.path.join(root, f) for f in files)
+    entries.sort()
+    if not entries:
+        raise FileNotFoundError(f"no files under checkpoint dir {path}")
+    for f in entries[keep_files:]:
+        os.remove(f)
+    victim = entries[0]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)

@@ -11,15 +11,20 @@ checkpoint), same meters and tensorboard tags, but:
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
+from mine_tpu.config import resilience_config_from_dict
+from mine_tpu.data.common import PIPELINE_STATS, RetryPolicy, set_retry_policy
 # prefetch is re-exported here for backward compatibility; it moved to the
 # input-pipeline module alongside the threaded assembler + device stager
 from mine_tpu.data.pipeline import DeviceStager, StagedBatch, prefetch  # noqa: F401
+from mine_tpu.testing import faults
+from mine_tpu.train import resilience
 from mine_tpu.train.checkpoint import CheckpointManager
 from mine_tpu.train.state import TrainState, current_lrs
 from mine_tpu.train.step import SynthesisTrainer
@@ -50,10 +55,25 @@ class TrainLoop:
         self.val_dataset = val_dataset
         self.logger = logger
         self.tb = tb_writer
+        self._tb_broken = False  # a failing TB writer degrades, not kills
+        self.resil = resilience_config_from_dict(self.config)
         self.ckpt = CheckpointManager(
             workspace,
             mirror_cmd=str(self.config.get("training.checkpoint_mirror_cmd",
-                                           "") or ""))
+                                           "") or ""),
+            keep=self.resil.checkpoint_keep,
+            logger=logger)
+        set_retry_policy(RetryPolicy(
+            max_item_retries=self.resil.max_item_retries,
+            backoff_s=self.resil.item_retry_backoff))
+        # SIGTERM/SIGINT -> emergency checkpoint at the next cadence
+        # boundary; all hosts agree via resilience.global_any before the
+        # collective save (installed for the duration of run())
+        self.preempt = resilience.PreemptionHandler(logger)
+        self.preempted = False
+        self.guard_monitor = resilience.GuardMonitor(
+            self.resil.guard_skip_threshold
+            if self.resil.guard_nonfinite else 0, logger)
 
         self.is_lead = jax.process_index() == 0
         self.train_meters = {k: AverageMeter("train_" + k)
@@ -110,24 +130,35 @@ class TrainLoop:
         steps_per_epoch = self.trainer.steps_per_epoch
         start_epoch = int(state.step) // steps_per_epoch + 1
 
-        for epoch in range(start_epoch, epochs + 1):
-            state = self.train_epoch(state, epoch)
-            if self.is_lead:
-                self._log("Epoch %d finished, average losses:" % epoch)
-                for m in self.train_meters.values():
-                    self._log("    %s" % m)
-                if self.time_meters["step_ms"].count:
-                    self._log("Epoch %d step-time breakdown (ms):" % epoch)
-                    for m in self.time_meters.values():
+        self.preempt.install()
+        try:
+            for epoch in range(start_epoch, epochs + 1):
+                state = self.train_epoch(state, epoch)
+                if not self.preempted and self.preempt.global_requested():
+                    self.preempted = True
+                if self.preempted:
+                    break
+                if self.is_lead:
+                    self._log("Epoch %d finished, average losses:" % epoch)
+                    for m in self.train_meters.values():
                         self._log("    %s" % m)
-        # final save: runs shorter than checkpoint_interval otherwise leave
-        # NO checkpoint_latest at all — the fixture end-to-end chain dies at
-        # eval and a killed short run has nothing to resume from (advisor
-        # r5; collective, every process participates)
-        self.ckpt.save_latest(state)
-        if self.is_lead:
-            self._log("Final checkpoint saved at step %d" % int(state.step))
-        self.ckpt.wait()
+                    if self.time_meters["step_ms"].count:
+                        self._log("Epoch %d step-time breakdown (ms):" % epoch)
+                        for m in self.time_meters.values():
+                            self._log("    %s" % m)
+            # final save: runs shorter than checkpoint_interval otherwise
+            # leave NO checkpoint_latest at all — the fixture end-to-end
+            # chain dies at eval and a killed short run has nothing to
+            # resume from (advisor r5; collective, every process
+            # participates). Under preemption this IS the emergency
+            # checkpoint.
+            self.ckpt.save_latest(state)
+            self._log("%s checkpoint saved at step %d"
+                      % ("Preemption" if self.preempted else "Final",
+                         int(state.step)))
+            self.ckpt.wait()
+        finally:
+            self.preempt.uninstall()
         return state
 
     # ---------------- epoch ----------------
@@ -174,15 +205,28 @@ class TrainLoop:
         for m in self.time_meters.values():
             m.reset()
 
-        staged = self._staged_batches(self._epoch_host_batches(epoch))
-
         # gstep is tracked on the HOST (the jitted step increments
         # state.step by exactly 1): reading int(state.step) every
         # iteration would block on the step's completion and serialize
         # device compute with the host feed — the pre-pipeline loop paid
-        # that sync each step.
+        # that sync each step. It is reconciled against the device counter
+        # at every checkpoint boundary (below), so drift can't silently
+        # shift the ckpt/eval cadence after resume.
         gstep = int(state.step)
-        step_in_epoch = 0
+        host_batches = self._epoch_host_batches(epoch)
+        offset = gstep - (epoch - 1) * self.trainer.steps_per_epoch
+        if offset > 0:
+            # mid-epoch resume: the epoch iterator always starts at batch 0,
+            # but the restored step counter is past it — skip the
+            # already-trained host batches so the resumed sequence continues
+            # exactly where the interrupted run stopped (cheap: skipped
+            # batches never reach the device stager)
+            self._log("Resuming epoch %d mid-way: skipping %d "
+                      "already-trained batches" % (epoch, offset))
+            host_batches = itertools.islice(host_batches, offset, None)
+        staged = self._staged_batches(host_batches)
+
+        step_in_epoch = offset if offset > 0 else 0
         t_last = time.perf_counter()
         host_wait_s = 0.0
         h2d_ms_acc = 0.0
@@ -199,8 +243,27 @@ class TrainLoop:
             step_in_epoch += 1
             gstep += 1
             steps_since_log += 1
+            faults.maybe_sigterm(gstep)  # chaos-test seam (no-op unplanned)
 
-            if step_in_epoch % self.log_interval == 0 and self.is_lead:
+            at_log = step_in_epoch % self.log_interval == 0
+            if at_log and self.guard_monitor.threshold > 0:
+                # abort policy over the replicated guard counters: EVERY
+                # host syncs the same two scalars and reaches the same
+                # verdict (raising on the lead only would deadlock the
+                # others in the next collective)
+                gm = {k: float(metrics[k])
+                      for k in ("skipped_steps", "guard_consecutive",
+                                "guard_last_bad_step") if k in metrics}
+                try:
+                    self.guard_monitor.check(gm, gstep)
+                except resilience.GuardAbort:
+                    # params are still at their last good values (the guard
+                    # zero-updates poisoned steps) — save them before dying
+                    self.ckpt.save_latest(state)
+                    self.ckpt.wait()
+                    raise
+
+            if at_log and self.is_lead:
                 m = metrics_to_float(metrics)  # device sync, log steps only
                 dt = (time.perf_counter() - t_last) / steps_since_log
                 times = {
@@ -220,9 +283,26 @@ class TrainLoop:
             # only logging/TB writes are lead-gated.
             did_pause = False
             if gstep > 0 and gstep % self.ckpt_interval == 0:
+                # reconcile the host counter with the device's before the
+                # cadence-bearing save (satellite: a drifted counter must
+                # not silently shift ckpt/eval cadence after resume)
+                dev_step = int(state.step)
+                if dev_step != gstep:
+                    if self.logger is not None:
+                        self.logger.warning(
+                            "host step counter drifted (host %d, device %d)"
+                            " — reconciling to the device", gstep, dev_step)
+                    gstep = dev_step
                 self.ckpt.save_latest(state)
                 self._log("Latest checkpoint saved at step %d" % gstep)
                 did_pause = True
+                if self.preempt.global_requested():
+                    # all hosts agreed: the boundary save above is the
+                    # emergency checkpoint — stop feeding and unwind
+                    self.preempted = True
+                    self._log("Preemption requested — stopping after the "
+                              "step-%d checkpoint" % gstep)
+                    break
 
             if gstep > 0 and (gstep == 2000 or gstep % self.eval_interval == 0) \
                     and self.val_dataset is not None:
@@ -324,9 +404,8 @@ class TrainLoop:
         self._log("Evaluation finished, average losses:")
         for m in self.val_meters.values():
             self._log("    %s" % m)
-        if self.tb is not None:
-            for k, meter in self.val_meters.items():
-                self.tb.add_scalar(k + "/val", meter.avg, gstep)
+        for k, meter in self.val_meters.items():
+            self._tb("add_scalar", k + "/val", meter.avg, gstep)
         return {k: meter.avg for k, meter in self.val_meters.items()}
 
     # ---------------- logging ----------------
@@ -335,30 +414,50 @@ class TrainLoop:
         if self.logger is not None and self.is_lead:
             self.logger.info(msg, *args)
 
+    def _tb(self, method, *args):
+        """Non-fatal tensorboard write: a broken writer (full disk, dead
+        tensorboardX backend) degrades to scalar-log-only instead of
+        killing a multi-hour run; one warning, then silence."""
+        if self.tb is None or self._tb_broken:
+            return
+        try:
+            getattr(self.tb, method)(*args)
+        except Exception:
+            self._tb_broken = True
+            if self.logger is not None:
+                self.logger.warning(
+                    "tensorboard writer failed — disabling TB output for "
+                    "the rest of the run", exc_info=True)
+
     def _log_training(self, epoch, step, gstep, m, times):
         lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
+        data_stats = PIPELINE_STATS.snapshot()
         self._log(
             "epoch [%.3d] step [%d] global_step = %d total_loss = %.4f "
             "encoder_lr = %.7f step_time = %.3fs\n"
             "        src: rgb = %.4f ssim = %.4f disp_pt3d = %.4f\n"
             "        tgt: rgb = %.4f ssim = %.4f disp_pt3d = %.4f psnr = %.2f\n"
-            # parseable pipeline breakdown (tools/step_breakdown.py)
+            # parseable pipeline breakdown (tools/step_breakdown.py);
+            # data_errors is the cumulative failed-item-load count
+            # (data/common.PIPELINE_STATS) — 0 on a healthy run
             "        time: step = %.1f ms host_wait = %.1f ms "
-            "device = %.1f ms h2d = %.1f ms"
+            "device = %.1f ms h2d = %.1f ms data_errors = %d"
             % (epoch, step, gstep, m["loss"], lrs["backbone"],
                times["step_ms"] / 1e3,
                m["loss_rgb_src"], m["loss_ssim_src"], m["loss_disp_pt3dsrc"],
                m["loss_rgb_tgt"], m["loss_ssim_tgt"], m["loss_disp_pt3dtgt"],
                m["psnr_tgt"],
                times["step_ms"], times["host_wait_ms"], times["device_ms"],
-               times["h2d_ms"]))
+               times["h2d_ms"], data_stats["data_errors"]))
         for k, meter in self.time_meters.items():
             meter.update(times[k])
-            if self.tb is not None:
-                self.tb.add_scalar("time/" + k, times[k], gstep)
+            self._tb("add_scalar", "time/" + k, times[k], gstep)
+        self._tb("add_scalar", "data/errors", data_stats["data_errors"],
+                 gstep)
         # diagnostics beyond the fixed reference meter set (e.g.
-        # warp_fallback_frac from the guarded warp backends) get meters on
-        # first sight so they reach the epoch summaries and TB too
+        # warp_fallback_frac from the guarded warp backends, the
+        # non-finite-guard counters) get meters on first sight so they
+        # reach the epoch summaries and TB too
         for k in m:
             if k not in self.train_meters:
                 self.train_meters[k] = AverageMeter("train_" + k)
@@ -366,26 +465,26 @@ class TrainLoop:
             if k not in m:
                 continue  # meter from a previous backend config
             meter.update(m[k])
-            if self.tb is not None:
-                self.tb.add_scalar(k + "/train", m[k], gstep)
+            self._tb("add_scalar", k + "/train", m[k], gstep)
 
     def _log_val_images(self, gstep, batch, visuals):
-        """Tensorboard image grids (synthesis_task.log_val :509-548)."""
+        """Tensorboard image grids (synthesis_task.log_val :509-548);
+        non-fatal — see _tb."""
         def grid(x_bchw):
             x = np.asarray(x_bchw)
             return np.clip(np.concatenate(list(x), axis=2), 0.0, 1.0)
 
         src = np.transpose(np.asarray(batch["src_img"]), (0, 3, 1, 2))
         tgt = np.transpose(np.asarray(batch["tgt_img"]), (0, 3, 1, 2))
-        self.tb.add_image("00_src_images", grid(src), gstep)
-        self.tb.add_image("01_gt_tgt_images", grid(tgt), gstep)
-        self.tb.add_image("02_syn_src_images/step_%d" % gstep,
-                          grid(visuals["src_imgs_syn"]), gstep)
-        self.tb.add_image("03_syn_src_disparity_map/step_%d" % gstep,
-                          grid(disparity_normalization_vis(
-                              np.asarray(visuals["src_disparity_syn"]))), gstep)
-        self.tb.add_image("04_syn_tgt_images/step_%d" % gstep,
-                          grid(visuals["tgt_imgs_syn"]), gstep)
-        self.tb.add_image("05_syn_tgt_disparity_map/step_%d" % gstep,
-                          grid(disparity_normalization_vis(
-                              np.asarray(visuals["tgt_disparity_syn"]))), gstep)
+        self._tb("add_image", "00_src_images", grid(src), gstep)
+        self._tb("add_image", "01_gt_tgt_images", grid(tgt), gstep)
+        self._tb("add_image", "02_syn_src_images/step_%d" % gstep,
+                 grid(visuals["src_imgs_syn"]), gstep)
+        self._tb("add_image", "03_syn_src_disparity_map/step_%d" % gstep,
+                 grid(disparity_normalization_vis(
+                     np.asarray(visuals["src_disparity_syn"]))), gstep)
+        self._tb("add_image", "04_syn_tgt_images/step_%d" % gstep,
+                 grid(visuals["tgt_imgs_syn"]), gstep)
+        self._tb("add_image", "05_syn_tgt_disparity_map/step_%d" % gstep,
+                 grid(disparity_normalization_vis(
+                     np.asarray(visuals["tgt_disparity_syn"]))), gstep)

@@ -1,0 +1,154 @@
+"""Training-resilience layer: the host-side halves of fault tolerance.
+
+Three failure modes dominate real TPU-pod training and each gets a
+coordinated device+host treatment here:
+
+  * numeric blow-ups — the all-finite step guard lives INSIDE the jitted
+    train step (train/step.py) so skipping a poisoned step costs no host
+    sync; this module supplies the pure tree-select (`select_tree`) and
+    the host-side abort policy (`GuardMonitor`) that reads the guard
+    counters off the metrics at log cadence and aborts after too many
+    CONSECUTIVE skips (a persistent blow-up means the run is dead —
+    looping forever on zero-updates just burns the reservation).
+  * preemption — `PreemptionHandler` turns SIGTERM/SIGINT into a host
+    flag; the loop folds it into a tiny all-host agreement at each
+    checkpoint-cadence boundary (`global_any`) so every process saves the
+    same emergency `checkpoint_latest` and exits cleanly. Single-process
+    runs skip the collective entirely.
+  * data corruption — handled in data/common.py (bounded per-item retry +
+    deterministic quarantine) and data/pipeline.py (worker respawn); the
+    loop surfaces the counters via data/common.PIPELINE_STATS.
+
+Checkpoint hardening (commit markers, retention, the restore fallback
+chain) lives with the manager in train/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def select_tree(keep_new, new_tree, old_tree):
+    """Elementwise tree select: `keep_new` (bool scalar) picks every leaf of
+    new_tree, else old_tree — the zero-update primitive of the step guard.
+    Fuses into the step program; no extra memory beyond the selects."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(keep_new, n, o), new_tree, old_tree)
+
+
+def global_any(flag: bool) -> bool:
+    """All-host agreement on a host-side boolean.
+
+    Multi-host SPMD requires every process to take the same
+    save-and-exit branch or the next collective deadlocks; a SIGTERM
+    often reaches only some hosts (maintenance drains one VM at a time).
+    Single process: the local flag, no device work. Multi-host: a tiny
+    allgather-any over one int32 per host — called at checkpoint-cadence
+    boundaries only, never per step.
+    """
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if flag else 0], np.int32))
+    return bool(np.asarray(flags).sum() > 0)
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT -> a sticky host flag, read at cadence boundaries.
+
+    The handler only flips a flag — no I/O, no jax calls — so it is safe
+    at any interrupt point. A second SIGINT restores Python's default
+    KeyboardInterrupt so a stuck run can still be killed interactively.
+    `install()`/`uninstall()` nest safely; uninstall restores whatever
+    handlers were active before.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, logger=None):
+        self._logger = logger
+        self._flag = threading.Event()
+        self._prev = None
+
+    def _handle(self, signum, frame):
+        if self._flag.is_set() and signum == signal.SIGINT:
+            # second Ctrl-C: the user means it — stop swallowing
+            signal.signal(signal.SIGINT, signal.default_int_handler)
+            raise KeyboardInterrupt
+        self._flag.set()
+        if self._logger is not None:
+            try:
+                self._logger.info(
+                    "Signal %d received — will checkpoint and exit at the "
+                    "next checkpoint boundary", signum)
+            except Exception:
+                pass  # logging must never break the handler
+
+    def install(self) -> "PreemptionHandler":
+        if self._prev is None and \
+                threading.current_thread() is threading.main_thread():
+            self._prev = {s: signal.signal(s, self._handle)
+                          for s in self.SIGNALS}
+        return self
+
+    def uninstall(self):
+        if self._prev is not None:
+            for s, h in self._prev.items():
+                signal.signal(s, h)
+            self._prev = None
+
+    @property
+    def requested(self) -> bool:
+        """This host's local flag (free; no collective)."""
+        return self._flag.is_set()
+
+    def global_requested(self) -> bool:
+        """All-host agreement — call at checkpoint-cadence boundaries."""
+        return global_any(self._flag.is_set())
+
+
+class GuardMonitor:
+    """Host policy over the step guard's counters (read at log cadence).
+
+    The device guard (train/step.py) swaps poisoned updates for
+    zero-updates and counts them; this monitor decides when skipping has
+    gone from "rode out a transient" to "the run is dead". `threshold`
+    consecutive skips -> GuardAbort. threshold <= 0 disables the abort
+    (the guard itself still skips).
+    """
+
+    def __init__(self, threshold: int, logger=None):
+        self.threshold = int(threshold)
+        self._logger = logger
+        self._last_reported = 0
+
+    def check(self, metrics: dict, gstep: int):
+        """`metrics` is the host-side float dict of a LOG step (the only
+        cadence at which metrics are synced anyway)."""
+        skipped = int(metrics.get("skipped_steps", 0))
+        consecutive = int(metrics.get("guard_consecutive", 0))
+        if skipped > self._last_reported and self._logger is not None:
+            self._logger.info(
+                "Non-finite step guard: %d step(s) skipped so far "
+                "(last bad step %d, %d consecutive)", skipped,
+                int(metrics.get("guard_last_bad_step", -1)), consecutive)
+            self._last_reported = skipped
+        if self.threshold > 0 and consecutive >= self.threshold:
+            raise GuardAbort(
+                f"{consecutive} consecutive non-finite training steps at "
+                f"global step {gstep} (threshold "
+                f"training.guard_skip_threshold={self.threshold}): the "
+                f"blow-up is persistent, aborting instead of looping on "
+                f"zero-updates. Last good params are in the emergency "
+                f"checkpoint.")
+
+
+class GuardAbort(RuntimeError):
+    """Persistent non-finite steps: training aborted by the guard."""

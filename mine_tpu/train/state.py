@@ -28,6 +28,21 @@ class TrainState:
     batch_stats: Any
     opt_state: Any
     rng: jax.Array             # folded with step per training step
+    guard: jax.Array           # int32 [3] non-finite-step-guard counters
+
+
+# Indices into TrainState.guard — kept as one small device buffer (not
+# separate fields) so the checkpoint layer can strip/inject it wholesale:
+# on-disk checkpoints keep the stable 5-key tree and stay readable across
+# guard changes, and the counters reset on restore (they are diagnostics
+# of THIS run, not model state — see MIGRATION.md).
+GUARD_SKIPPED = 0    # total steps skipped (non-finite loss/grad-norm)
+GUARD_CONSEC = 1     # current run of consecutive skips (abort signal)
+GUARD_LAST_BAD = 2   # state.step of the most recent skipped step, -1 never
+
+
+def make_guard_buffer() -> jnp.ndarray:
+    return jnp.asarray([0, 0, -1], jnp.int32)
 
 
 def multistep_lr(base_lr: float, decay_epochs, gamma: float,
@@ -102,7 +117,8 @@ def create_train_state(model, config: Dict[str, Any], steps_per_epoch: int,
                       params=params,
                       batch_stats=batch_stats,
                       opt_state=opt_state,
-                      rng=state_key)
+                      rng=state_key,
+                      guard=make_guard_buffer())
 
 
 def current_lrs(config: Dict[str, Any], steps_per_epoch: int, step: int):

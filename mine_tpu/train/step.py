@@ -28,8 +28,12 @@ from mine_tpu.config import (MPIConfig, mpi_config_from_dict,
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering, sampling
 from mine_tpu.parallel import mesh as mesh_lib
+from mine_tpu.testing import faults
+from mine_tpu.train import resilience
 from mine_tpu.train.loss import compute_losses
-from mine_tpu.train.state import TrainState, create_train_state, make_optimizer
+from mine_tpu.train.state import (GUARD_CONSEC, GUARD_LAST_BAD, GUARD_SKIPPED,
+                                  TrainState, create_train_state,
+                                  make_optimizer)
 
 
 def _remat_policy(value):
@@ -132,6 +136,16 @@ class SynthesisTrainer:
         assert self.grad_accum_steps >= 1, self.grad_accum_steps
         self.tx = make_optimizer(config, steps_per_epoch)
         self.lpips_params = lpips_params
+        # Non-finite step guard (training.guard_nonfinite, default on): the
+        # all-finite check and zero-update swap are traced INTO the step —
+        # no extra host sync, guard counters ride in TrainState.guard and
+        # surface through the (already log-cadence-synced) metrics.
+        self.guard_nonfinite = bool(config.get("training.guard_nonfinite",
+                                               True))
+        # Fault injection is resolved at TRACE time (set the plan before
+        # constructing the trainer): None in production, so the injected
+        # jnp.where never enters the compiled program.
+        self._nan_grad_window = faults.nan_grad_window()
 
         # compiler_options reach every jitted step — the multichip dry run
         # certifies CORRECTNESS of the sharded programs on a single-core
@@ -271,15 +285,53 @@ class SynthesisTrainer:
     def _train_step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         key = jax.random.fold_in(state.rng, state.step)
         grads, metrics, new_stats = self._grads_and_metrics(state, batch, key)
+        if self._nan_grad_window is not None:
+            # chaos-test seam: poison the gradients at the planned step(s);
+            # absent a plan this branch is not traced at all
+            at_step, from_step = self._nan_grad_window
+            poison = jnp.zeros((), bool)
+            if at_step >= 0:
+                poison |= state.step == at_step
+            if from_step >= 0:
+                poison |= state.step >= from_step
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(poison, jnp.asarray(jnp.nan, g.dtype), g),
+                grads)
         with jax.named_scope("adam_update"):
             updates, new_opt_state = self.tx.update(grads, state.opt_state,
                                                     state.params)
             new_params = optax.apply_updates(state.params, updates)
+        guard = state.guard
+        if self.guard_nonfinite:
+            with jax.named_scope("nonfinite_guard"):
+                gnorm = optax.global_norm(grads)
+                ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(gnorm)
+                # poisoned step -> zero-update: keep the old params /
+                # opt_state / batch_stats (step still advances, so the RNG
+                # stream and cadences stay aligned with an unpoisoned run)
+                new_params = resilience.select_tree(ok, new_params,
+                                                    state.params)
+                new_opt_state = resilience.select_tree(ok, new_opt_state,
+                                                       state.opt_state)
+                new_stats = resilience.select_tree(ok, new_stats,
+                                                   state.batch_stats)
+                bad = (~ok).astype(jnp.int32)
+                skipped = state.guard[GUARD_SKIPPED] + bad
+                consec = (state.guard[GUARD_CONSEC] + bad) * bad
+                last_bad = jnp.where(ok, state.guard[GUARD_LAST_BAD],
+                                     state.step.astype(jnp.int32))
+                guard = jnp.stack([skipped, consec, last_bad])
+                metrics = dict(metrics,
+                               grad_norm=gnorm,
+                               skipped_steps=skipped,
+                               guard_consecutive=consec,
+                               guard_last_bad_step=last_bad)
         new_state = TrainState(step=state.step + 1,
                                params=new_params,
                                batch_stats=new_stats,
                                opt_state=new_opt_state,
-                               rng=state.rng)
+                               rng=state.rng,
+                               guard=guard)
         return new_state, metrics
 
     def _eval_step_impl(self, state: TrainState, batch, eval_key,

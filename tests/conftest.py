@@ -39,6 +39,7 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 QUICK = {
     "test_bench_watchdog.py::test_physics_audit_rejects_above_peak_readings",
+    "test_chaos.py::test_fault_plan_spec_env_and_config",
     "test_checkpoint.py::test_restore_missing_returns_none",
     "test_composite_vjp.py::test_forward_values_match",
     "test_config.py::test_load_llff_config_merges_defaults",
@@ -91,6 +92,7 @@ MEDIUM_FILES = {
     "test_train_loop.py",
     "test_pipeline.py",
     "test_checkpoint.py",
+    "test_chaos.py",
     "test_loss_aggregation.py",
     # fused-pyramid equivalence vs the frozen per-scale reference (PR-2
     # tentpole): what a reviewer most wants re-run after touching the loss
@@ -121,6 +123,7 @@ def pytest_configure(config):
 HEAVY_LAST_FILES = (
     "test_fused_loss.py",
     "test_checkpoint.py",
+    "test_chaos.py",
     "test_pipeline.py",
     "test_first_real_run.py",
     "test_train_loop.py",

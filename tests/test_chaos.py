@@ -1,0 +1,577 @@
+"""Chaos suite: drives every fault-tolerance path end-to-end on CPU.
+
+Each test injects one production failure mode through the seams in
+mine_tpu/testing/faults.py and asserts the recovery contract:
+
+  * non-finite step guard — a NaN-poisoned step is skipped with params
+    bitwise-unchanged, counters advance, training continues; a persistent
+    blow-up aborts via GuardAbort AFTER saving an emergency checkpoint
+  * data degradation — a transient bad item heals bitwise via retry, a
+    persistent one is quarantined and deterministically replaced, a killed
+    assembler worker is respawned; none of them end the epoch
+  * preemption — SIGTERM mid-epoch yields a valid emergency checkpoint a
+    relaunch resumes EXACTLY (the interrupted+resumed loss sequence is
+    bitwise-identical to an uninterrupted run's)
+  * checkpoint hardening — partial dirs are overwritten, keep-K retention
+    holds, markers stay advisory on read, a truncated checkpoint_latest
+    falls back to the newest valid step checkpoint with a logged warning
+
+Compile budget: the jitted tests share TWO module-scope trainers (one
+clean, one traced with the NaN-grad injection — the fault window is read
+at trace time, so it needs its own program). Everything else is host-only.
+The subprocess SIGKILL determinism test is @slow (tier-1 runs the rest).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mine_tpu.data import common
+from mine_tpu.data.common import iterate_pair_batches
+from mine_tpu.testing import faults
+from mine_tpu.train import resilience
+from mine_tpu.train.checkpoint import CheckpointManager
+from mine_tpu.train.state import TrainState, make_guard_buffer
+from tests.test_pipeline import _make_get_pair
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """No fault plan or degradation counters may leak between tests."""
+    faults.set_plan(None)
+    common.PIPELINE_STATS.reset()
+    policy = common.get_retry_policy()
+    yield
+    faults.set_plan(None)
+    common.PIPELINE_STATS.reset()
+    common.set_retry_policy(policy)
+
+
+class _Logger:
+    def __init__(self):
+        self.infos = []
+        self.warnings = []
+
+    def info(self, msg, *args, **kw):
+        self.infos.append(msg % args if args else str(msg))
+
+    def warning(self, msg, *args, **kw):
+        self.warnings.append(msg % args if args else str(msg))
+
+
+# ---------------------------------------------------------------------------
+# fault-plan plumbing (no jit)
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_spec_env_and_config():
+    assert faults.plan_from_spec(None) is None
+    assert faults.plan_from_spec({}) is None
+    assert faults.plan_from_spec("") is None
+    p = faults.plan_from_spec({"sigterm_at_step": 7})
+    assert p.sigterm_at_step == 7 and p.active
+    assert faults.plan_from_spec('{"nan_grads_at_step": 3}').nan_grads_at_step == 3
+    assert not faults.FaultPlan().active
+    assert faults.plan_from_env({faults.ENV_VAR: '{"item_raise_index": 2}'}) \
+        .item_raise_index == 2
+    assert faults.plan_from_env({}) is None
+    # typo guard: unknown keys must fail loudly, not silently no-op
+    with pytest.raises(KeyError, match="unknown fault plan"):
+        faults.plan_from_spec({"nan_grads_at_stpe": 3})
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline degradation (no jit)
+# ---------------------------------------------------------------------------
+
+def _collect(get_pair, workers, num_items=23):
+    return list(iterate_pair_batches(num_items, get_pair, 4, False,
+                                     seed=3, epoch=2, workers=workers))
+
+
+def _assert_batches_equal(ref, got):
+    assert len(ref) == len(got)
+    for rb, gb in zip(ref, got):
+        assert sorted(rb) == sorted(gb)
+        for k in rb:
+            np.testing.assert_array_equal(rb[k], gb[k])
+
+
+def test_transient_item_failure_heals_bitwise():
+    """One failed load + retry must reproduce the never-failed run exactly:
+    the retry rebuilds the item RNG from scratch (counter-based)."""
+    common.set_retry_policy(common.RetryPolicy(max_item_retries=2,
+                                               backoff_s=0.0))
+    ref = _collect(_make_get_pair(23), workers=0)
+    faults.set_plan(faults.FaultPlan(item_raise_index=7, item_raise_times=1))
+    got = _collect(_make_get_pair(23), workers=0)
+    _assert_batches_equal(ref, got)
+    stats = common.PIPELINE_STATS.snapshot()
+    assert stats["data_errors"] == 1
+    assert stats["quarantined"] == 0
+
+
+def test_persistent_item_quarantined_and_replaced_deterministically():
+    """A persistently-bad item is quarantined after bounded retries and its
+    slot refilled with the next index IN SHARD ORDER, under the ORIGINAL
+    slot's RNG — so the degraded sequence is still worker-count-invariant
+    and every other slot stays bitwise-identical to the clean run."""
+    common.set_retry_policy(common.RetryPolicy(max_item_retries=1,
+                                               backoff_s=0.0))
+    ref = _collect(_make_get_pair(23), workers=0)
+    faults.set_plan(faults.FaultPlan(item_raise_index=7, item_raise_times=-1))
+    got0 = _collect(_make_get_pair(23), workers=0)
+    faults.set_plan(faults.FaultPlan(item_raise_index=7, item_raise_times=-1))
+    common.PIPELINE_STATS.reset()
+    got3 = _collect(_make_get_pair(23), workers=3)
+    _assert_batches_equal(got0, got3)  # degradation itself is deterministic
+    assert common.PIPELINE_STATS.is_quarantined(7)
+
+    # shuffle=False: slot 7 lives in batch 1 (positions 4..7); its integer
+    # part must now be the replacement item 8, every other slot untouched
+    for b, (rb, gb) in enumerate(zip(ref, got0)):
+        for j in range(4):
+            want = 8.0 if (b, j) == (1, 3) else np.floor(rb["src_img"][j, 0, 0, 0])
+            assert np.floor(gb["src_img"][j, 0, 0, 0]) == want, (b, j)
+    # untouched slots are bitwise-identical, not just same item
+    np.testing.assert_array_equal(ref[0]["src_img"], got0[0]["src_img"])
+
+
+def test_killed_worker_respawns_and_sequence_survives():
+    """A worker thread dying mid-assembly (BaseException, bypassing the
+    per-item retry) must requeue its batch and be respawned — the consumer
+    still sees the full, bitwise-correct batch sequence."""
+    ref = _collect(_make_get_pair(23), workers=0)
+    faults.set_plan(faults.FaultPlan(kill_worker_at_call=5))
+    got = _collect(_make_get_pair(23), workers=1)  # sole worker dies
+    _assert_batches_equal(ref, got)
+    assert common.PIPELINE_STATS.snapshot()["worker_respawns"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (no jit: a tiny fake TrainState)
+# ---------------------------------------------------------------------------
+
+def _fake_state(step: int) -> TrainState:
+    f = float(step)
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params={"backbone": {"w": jnp.arange(6, dtype=jnp.float32) + f},
+                "decoder": {"b": jnp.full((3,), f, jnp.float32)}},
+        batch_stats={"bn": {"mean": jnp.full((2,), f, jnp.float32)}},
+        opt_state={"mu": jnp.full((6,), f * 0.5, jnp.float32)},
+        rng=jax.random.PRNGKey(step),
+        guard=make_guard_buffer())
+
+
+def _assert_state_equal(a: TrainState, b: TrainState):
+    assert int(a.step) == int(b.step)
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        (a.params, a.batch_stats, a.opt_state, a.rng),
+        (b.params, b.batch_stats, b.opt_state, b.rng))
+
+
+def test_save_step_overwrites_partial_dir(tmp_path):
+    """The old `os.path.exists` guard refused to ever re-save a step whose
+    dir existed — a crash mid-save bricked that step forever. Marker-less
+    dirs are now treated as partial and overwritten; committed ones are
+    still final."""
+    log = _Logger()
+    mgr = CheckpointManager(str(tmp_path), logger=log)
+    partial = os.path.join(str(tmp_path), "checkpoint_%012d" % 5)
+    os.makedirs(partial)
+    with open(os.path.join(partial, "junk"), "w") as fh:
+        fh.write("crashed mid-write")
+
+    mgr.save_step(_fake_state(5))
+    mgr.wait()
+    assert any("overwriting incomplete" in w for w in log.warnings)
+    assert mgr.has_marker(partial)
+    got = mgr.restore(_fake_state(0), name=os.path.basename(partial))
+    _assert_state_equal(got, _fake_state(5))
+
+    # committed dir: a re-save of the same step is a no-op, not an error
+    n_warn = len(log.warnings)
+    mgr.save_step(_fake_state(5))
+    mgr.wait()
+    assert len(log.warnings) == n_warn
+
+
+def test_keep_last_k_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for step in (1, 2, 3, 4):
+        mgr.save_step(_fake_state(step))
+    mgr.wait()
+    mgr._retain()  # the newest save's retention ran before its own commit
+    kept = mgr.step_checkpoints()
+    assert [s for s, _ in kept] == [4, 3]
+    for _, path in kept:
+        assert mgr.has_marker(path)
+    # checkpoint_latest is exempt from retention
+    mgr.save_latest(_fake_state(9))
+    mgr.wait()
+    assert mgr.latest_exists()
+    assert [s for s, _ in mgr.step_checkpoints()] == [4, 3]
+
+
+def test_markers_advisory_on_read_and_guard_reset(tmp_path):
+    """Pre-marker workspaces (or hand-copied checkpoints) must restore
+    fine: markers gate writes, never reads. The guard buffer is a
+    diagnostic of the CURRENT run — restore re-injects the template's."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save_latest(_fake_state(6))
+    mgr.wait()
+    os.remove(mgr.marker_path(os.path.join(str(tmp_path),
+                                           "checkpoint_latest")))
+    template = _fake_state(0)
+    template = template.replace(guard=jnp.asarray([9, 9, 9], jnp.int32))
+    got = mgr.restore(template)
+    _assert_state_equal(got, _fake_state(6))
+    np.testing.assert_array_equal(np.asarray(got.guard), [9, 9, 9])
+
+
+def test_truncated_latest_falls_back_to_step_checkpoint(tmp_path):
+    """A checkpoint_latest corrupted the way a mid-write crash corrupts it
+    (half the files gone, a survivor truncated) must degrade to the newest
+    valid step checkpoint with a logged warning — not kill the run."""
+    log = _Logger()
+    mgr = CheckpointManager(str(tmp_path), logger=log)
+    mgr.save_step(_fake_state(3))
+    mgr.save_step(_fake_state(4))
+    mgr.save_latest(_fake_state(6))
+    mgr.wait()
+    latest = os.path.join(str(tmp_path), "checkpoint_latest")
+    faults.truncate_checkpoint(latest)
+    os.remove(mgr.marker_path(latest))  # crash happened before the commit
+
+    got = mgr.restore(_fake_state(0))
+    _assert_state_equal(got, _fake_state(4))
+    assert any("failed to restore" in w and "partial" in w
+               for w in log.warnings)
+    assert any("restored fallback checkpoint" in w for w in log.warnings)
+
+    # every candidate corrupt -> the chain raises with the mismatch hint
+    faults.truncate_checkpoint(os.path.join(str(tmp_path),
+                                            "checkpoint_%012d" % 4))
+    faults.truncate_checkpoint(os.path.join(str(tmp_path),
+                                            "checkpoint_%012d" % 3))
+    with pytest.raises(RuntimeError, match="grad_accum_steps"):
+        mgr.restore(_fake_state(0))
+
+
+def test_restore_empty_workspace_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.restore(_fake_state(0)) is None
+    assert mgr.restore(_fake_state(0), name="checkpoint_000000000099") is None
+
+
+# ---------------------------------------------------------------------------
+# host resilience primitives (no jit)
+# ---------------------------------------------------------------------------
+
+def test_preemption_handler_flag_and_uninstall():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    log = _Logger()
+    h = resilience.PreemptionHandler(log).install()
+    try:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 2.0
+        while not h.requested and time.time() < deadline:
+            time.sleep(0.01)
+        assert h.requested
+        assert h.global_requested()  # single process: the local flag
+        assert any("checkpoint and exit" in m for m in log.infos)
+    finally:
+        h.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+
+
+def test_guard_monitor_reports_and_aborts():
+    log = _Logger()
+    mon = resilience.GuardMonitor(threshold=3, logger=log)
+    mon.check({"skipped_steps": 0.0, "guard_consecutive": 0.0,
+               "guard_last_bad_step": -1.0}, gstep=10)
+    assert not log.infos
+    mon.check({"skipped_steps": 2.0, "guard_consecutive": 2.0,
+               "guard_last_bad_step": 11.0}, gstep=12)
+    assert any("2 step(s) skipped" in m for m in log.infos)
+    with pytest.raises(resilience.GuardAbort, match="3 consecutive"):
+        mon.check({"skipped_steps": 3.0, "guard_consecutive": 3.0,
+                   "guard_last_bad_step": 12.0}, gstep=13)
+    # threshold <= 0 disables the abort but the guard still skips/reports
+    resilience.GuardMonitor(threshold=0).check(
+        {"skipped_steps": 99.0, "guard_consecutive": 99.0}, gstep=1)
+
+
+# ---------------------------------------------------------------------------
+# jitted halves: two shared trainers (one compile each)
+# ---------------------------------------------------------------------------
+
+def _chaos_config(**overrides):
+    from tests.test_train import tiny_config
+    base = {
+        "data.img_h": 32, "data.img_w": 32,
+        "data.num_workers": 0,
+        "training.log_interval": 1,
+        "training.checkpoint_interval": 100,
+        "training.eval_interval": 10 ** 9,
+    }
+    base.update(overrides)
+    return tiny_config(**base)
+
+
+def _build(cfg):
+    from mine_tpu.data.synthetic import SyntheticPairDataset
+    from mine_tpu.train.step import SynthesisTrainer
+    data = SyntheticPairDataset(num_views=8, num_points=16,
+                                height=32, width=32, seed=0)  # 7 steps/epoch
+    return SynthesisTrainer(cfg, steps_per_epoch=len(data)), data
+
+
+@pytest.fixture(scope="module")
+def guard_setup():
+    """Trainer traced WITH the NaN-grad injection active (the fault window
+    is read at trainer construction / trace time): grads are poisoned at
+    every state.step >= 3. The global plan is cleared right after — only
+    the baked-in window persists."""
+    faults.set_plan(faults.FaultPlan(nan_grads_from_step=3))
+    try:
+        trainer, data = _build(_chaos_config(
+            **{"training.guard_skip_threshold": 2}))
+    finally:
+        faults.set_plan(None)
+    return trainer, data
+
+
+@pytest.fixture(scope="module")
+def clean_setup():
+    trainer, data = _build(_chaos_config(
+        **{"training.checkpoint_interval": 2}))
+    return trainer, data
+
+
+def _one_batch(data):
+    return next(iter(data.batch_iterator(batch_size=1, shuffle=True,
+                                         seed=0, epoch=1)))
+
+
+def test_guard_skips_nonfinite_step_params_unchanged(guard_setup):
+    """The tentpole's core contract: a poisoned step is a zero-update —
+    params/opt_state bitwise-unchanged, step still increments, counters
+    advance — and training continues (the next finite step would apply)."""
+    trainer, data = guard_setup
+    np_batch = _one_batch(data)
+    state = trainer.init_state(batch_size=1, seed=0)
+    for _ in range(3):  # input steps 0,1,2: before the poison window
+        state, metrics = trainer.train_step(state, trainer.put_batch(np_batch))
+    assert float(metrics["skipped_steps"]) == 0
+    assert np.isfinite(float(metrics["loss"]))
+
+    # the state is DONATED into the step: copy to host before comparing
+    params_before = jax.tree_util.tree_map(np.asarray, state.params)
+    opt_before = jax.tree_util.tree_map(np.asarray, state.opt_state)
+    state, metrics = trainer.train_step(state, trainer.put_batch(np_batch))
+    assert int(state.step) == 4  # step increments even when skipped
+    assert float(metrics["skipped_steps"]) == 1
+    assert float(metrics["guard_consecutive"]) == 1
+    assert float(metrics["guard_last_bad_step"]) == 3
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        state.params, params_before)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        state.opt_state, opt_before)
+
+    state, metrics = trainer.train_step(state, trainer.put_batch(np_batch))
+    assert float(metrics["skipped_steps"]) == 2
+    assert float(metrics["guard_consecutive"]) == 2
+    assert float(metrics["guard_last_bad_step"]) == 4
+
+
+def test_guard_abort_saves_emergency_checkpoint(guard_setup, tmp_path):
+    """Persistent blow-up: the loop aborts via GuardAbort once the
+    consecutive-skip threshold (2 here) trips at log cadence — but only
+    AFTER saving checkpoint_latest, whose params are still the last good
+    ones (the guard zero-updated every poisoned step)."""
+    from mine_tpu.train.loop import TrainLoop
+    trainer, data = guard_setup
+    log = _Logger()
+    loop = TrainLoop(trainer, data, None, str(tmp_path / "ws"),
+                     logger=log, tb_writer=None)
+    assert loop.guard_monitor.threshold == 2
+    state = trainer.init_state(batch_size=1, seed=0)
+    with pytest.raises(resilience.GuardAbort, match="2 consecutive"):
+        loop.train_epoch(state, epoch=1)
+    assert any("skipped so far" in m for m in log.infos)
+    assert loop.ckpt.latest_exists()
+    restored = loop.ckpt.restore(trainer.init_state(batch_size=1, seed=0))
+    # poison from input step 3 -> skips at gstep 4,5; abort at gstep 5
+    assert int(restored.step) == 5
+
+
+class _StepTrace:
+    """Record (global step, loss) per train_step — restores the trainer's
+    original step on exit so module-scope fixtures stay clean."""
+
+    def __init__(self, trainer):
+        self.trainer = trainer
+        self.steps = {}
+
+    def __enter__(self):
+        self._orig = self.trainer.train_step
+
+        def tracing(state, batch):
+            state, metrics = self._orig(state, batch)
+            self.steps[int(state.step)] = float(np.asarray(metrics["loss"]))
+            return state, metrics
+
+        self.trainer.train_step = tracing
+        return self
+
+    def __exit__(self, *exc):
+        self.trainer.train_step = self._orig
+
+
+def test_sigterm_preemption_checkpoints_and_resumes_exactly(clean_setup,
+                                                            tmp_path):
+    """SIGTERM mid-epoch -> emergency checkpoint at the next cadence
+    boundary + clean stop; a relaunch resumes mid-epoch (skipping the
+    already-trained batches) and the interrupted+resumed loss sequence is
+    bitwise-identical to an uninterrupted run's."""
+    from mine_tpu.train.loop import TrainLoop
+    trainer, data = clean_setup
+
+    # uninterrupted reference (its own workspace)
+    with _StepTrace(trainer) as ref:
+        TrainLoop(trainer, data, None, str(tmp_path / "ref"),
+                  logger=None).run(trainer.init_state(1, seed=0), epochs=1)
+    assert sorted(ref.steps) == [1, 2, 3, 4, 5, 6, 7]
+
+    # interrupted leg: SIGTERM at gstep 3, checkpoint_interval 2 -> the
+    # boundary at gstep 4 saves the emergency checkpoint and stops
+    ws = str(tmp_path / "chaos")
+    faults.set_plan(faults.FaultPlan(sigterm_at_step=3))
+    loop = TrainLoop(trainer, data, None, ws, logger=None)
+    with _StepTrace(trainer) as leg1:
+        loop.run(trainer.init_state(1, seed=0), epochs=1)
+    faults.set_plan(None)
+    assert loop.preempted
+    assert sorted(leg1.steps) == [1, 2, 3, 4]
+    assert loop.ckpt.latest_exists()
+
+    # resumed leg: restores step 4, skips 4 batches, finishes the epoch
+    log = _Logger()
+    loop2 = TrainLoop(trainer, data, None, ws, logger=log)
+    with _StepTrace(trainer) as leg2:
+        final = loop2.run(trainer.init_state(1, seed=0), epochs=1)
+    assert not loop2.preempted
+    assert int(final.step) == 7
+    assert any("Resumed from checkpoint at step 4" in m for m in log.infos)
+    assert any("skipping 4 already-trained batches" in m for m in log.infos)
+    assert sorted(leg2.steps) == [5, 6, 7]
+
+    merged = {**leg1.steps, **leg2.steps}
+    assert merged == ref.steps  # bitwise float equality, every step
+
+
+def test_gstep_reconcile_warns_on_host_device_drift(clean_setup, tmp_path):
+    """If the host-side step counter ever disagrees with the device's at a
+    checkpoint boundary, the loop must warn and reconcile to the device
+    (cadence-bearing) counter instead of silently shifting the cadence."""
+    from mine_tpu.train.loop import TrainLoop
+    trainer, data = clean_setup
+    log = _Logger()
+    loop = TrainLoop(trainer, data, None, str(tmp_path / "ws"), logger=log)
+    orig = trainer.train_step
+
+    def drifting(state, batch):  # device counter runs 2x the host's
+        state, metrics = orig(state, batch)
+        return state.replace(step=state.step + 1), metrics
+
+    trainer.train_step = drifting
+    try:
+        loop.train_epoch(trainer.init_state(1, seed=0), epoch=1)
+    finally:
+        trainer.train_step = orig
+        loop.ckpt.wait()  # settle the boundary save before teardown
+    assert any("host step counter drifted" in w for w in log.warnings)
+
+
+def test_tb_writer_failure_degrades_not_fatal(clean_setup, tmp_path):
+    from mine_tpu.train.loop import TrainLoop
+
+    class BrokenTB:
+        def add_scalar(self, *a):
+            raise RuntimeError("disk full")
+
+        add_image = add_scalar
+
+    trainer, data = clean_setup
+    log = _Logger()
+    loop = TrainLoop(trainer, data, None, str(tmp_path / "ws"),
+                     logger=log, tb_writer=BrokenTB())
+    loop._tb("add_scalar", "x/train", 1.0, 1)
+    assert loop._tb_broken
+    assert len(log.warnings) == 1
+    loop._tb("add_scalar", "x/train", 2.0, 2)  # silent after the first
+    assert len(log.warnings) == 1
+
+
+# ---------------------------------------------------------------------------
+# kill/resume determinism across PROCESS death (subprocess; slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_resume_is_bitwise_deterministic(tmp_path):
+    """The full-fidelity drill: SIGKILL (no handler can run) a training
+    subprocess mid-epoch, relaunch it on the same workspace, and require
+    the union of the two legs' per-step losses to match an uninterrupted
+    subprocess run exactly. Driven through tools/chaos_soak.py `run`."""
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools)
+    try:
+        import chaos_soak
+    finally:
+        sys.path.pop(0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def leg(ws, steps_file, wait=True):
+        cmd = [sys.executable, os.path.join(tools, "chaos_soak.py"), "run",
+               "--workspace", str(tmp_path / ws),
+               "--steps-file", str(tmp_path / steps_file),
+               "--epochs", "1", "--num-views", "6"]
+        proc = subprocess.Popen(cmd, env=env)
+        if wait:
+            assert proc.wait(600) == 0
+        return proc
+
+    leg("ref_ws", "ref.txt")
+    ref = chaos_soak.read_trace(str(tmp_path / "ref.txt"))
+    assert sorted(ref) == [1, 2, 3, 4, 5]
+
+    # SIGKILL the chaos leg once it is past the step-3 checkpoint
+    proc = leg("chaos_ws", "chaos.txt", wait=False)
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if len(chaos_soak.read_trace(str(tmp_path / "chaos.txt"))) >= 4:
+            os.kill(proc.pid, signal.SIGKILL)
+            break
+        if proc.poll() is not None:
+            pytest.fail("chaos leg finished before it could be killed")
+        time.sleep(0.2)
+    assert proc.wait(60) != 0
+
+    leg("chaos_ws", "chaos.txt")  # relaunch: resumes from the workspace
+    chaos = chaos_soak.read_trace(str(tmp_path / "chaos.txt"))
+    assert chaos == ref  # bitwise: repr'd losses, last occurrence per step

@@ -71,12 +71,24 @@ def test_assembler_matches_sequential():
 
 
 def test_assembler_worker_error_propagates():
-    get_pair = _make_get_pair(num_items=23, fail_at=11)
-    with pytest.raises(ValueError, match="boom at 11"):
-        _collect(get_pair=get_pair, shuffle=False, workers=3)
-    # synchronous path raises the same error for the same data
-    with pytest.raises(ValueError, match="boom at 11"):
-        _collect(get_pair=get_pair, shuffle=False, workers=0)
+    """A single persistently-bad item no longer kills the epoch (bounded
+    retry + quarantine, covered in test_chaos.py) — but a dataset where
+    EVERY load fails still must fail loudly, on both feed paths."""
+    def all_fail(index, rng=None):
+        raise ValueError("boom at %d" % index)
+
+    policy = common.get_retry_policy()
+    common.set_retry_policy(common.RetryPolicy(max_item_retries=0,
+                                               backoff_s=0.0))
+    try:
+        with pytest.raises(RuntimeError, match="every candidate"):
+            _collect(get_pair=all_fail, shuffle=False, workers=3)
+        # synchronous path raises the same error for the same data
+        with pytest.raises(RuntimeError, match="every candidate"):
+            _collect(get_pair=all_fail, shuffle=False, workers=0)
+    finally:
+        common.set_retry_policy(policy)
+        common.PIPELINE_STATS.reset()
 
 
 def test_assembler_shutdown_on_abandon():

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Chaos driver for the fault-tolerance layer — a train_cli-shaped run on
+the synthetic dataset with fault injection and a per-step loss trace.
+
+`run` executes one (resumable) training leg and appends every step's loss
+to --steps-file as "step,repr(loss)" lines (flushed per step, so a parent
+process can SIGKILL this one mid-epoch and diff the trace later):
+
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py run \
+      --workspace /tmp/ws --epochs 2 --steps-file /tmp/steps.txt \
+      --faults '{"nan_grads_from_step": 5}'
+
+Relaunching the identical command resumes from the workspace's
+checkpoint_latest and continues the trace — the kill/resume determinism
+test (tests/test_chaos.py) asserts the union of interrupted traces is
+bitwise-identical to an uninterrupted run's.
+
+`soak` wraps `run` in repeated SIGKILL-at-a-random-step cycles in
+subprocesses until the run completes, then verifies the stitched trace
+against a clean reference — the host-side sibling of the on-TPU soak:
+
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py soak --workspace /tmp/ws
+
+Faults come from --faults JSON or the MINE_TPU_FAULTS env var (env wins;
+see mine_tpu/testing/faults.py for the keys).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_config(overrides=None):
+    """The chaos fixture config: tiny everything, CPU-friendly, cadences
+    tight enough that one short epoch crosses checkpoint boundaries."""
+    from mine_tpu.config import CONFIG_DIR, load_config
+    cfg = load_config(os.path.join(CONFIG_DIR, "params_default.yaml"))
+    cfg.update({
+        "data.name": "llff",
+        "data.img_h": 32, "data.img_w": 32,
+        "data.per_gpu_batch_size": 1,
+        "mpi.num_bins_coarse": 4,
+        "mpi.disparity_start": 1.0, "mpi.disparity_end": 0.2,
+        "model.num_layers": 18,
+        "lr.backbone_lr": 1e-3, "lr.decoder_lr": 1e-3,
+        "lr.decay_steps": [1000],
+        "loss.smoothness_lambda_v1": 0.0,
+        "loss.smoothness_lambda_v2": 0.0,
+        "training.dtype": "float32",
+        "training.log_interval": 1,
+        "training.checkpoint_interval": 3,
+        "training.eval_interval": 10 ** 9,  # no eval: keep the leg to one compile
+        "data.num_workers": 2,
+        "data.item_retry_backoff": 0.0,
+    })
+    cfg.update(overrides or {})
+    return cfg
+
+
+def make_loop(workspace, steps_file=None, overrides=None, num_views=6,
+              logger=None):
+    """Build (trainer, loop, dataset) for one leg; when steps_file is set
+    the trainer's train_step is wrapped to append "step,repr(loss)" per
+    step (synced per step — this is a test harness, not a benchmark)."""
+    from mine_tpu.data.synthetic import SyntheticPairDataset
+    from mine_tpu.train.loop import TrainLoop
+    from mine_tpu.train.step import SynthesisTrainer
+
+    cfg = build_config(overrides)
+    data = SyntheticPairDataset(num_views=num_views, num_points=16,
+                                height=32, width=32, seed=0)
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=len(data))
+    loop = TrainLoop(trainer, data, None, workspace, logger=logger,
+                     tb_writer=None)
+    if steps_file is not None:
+        orig = trainer.train_step
+
+        def tracing_step(state, batch):
+            state, metrics = orig(state, batch)
+            with open(steps_file, "a") as fh:
+                fh.write("%d,%r\n" % (int(state.step),
+                                      float(metrics["loss"])))
+                fh.flush()
+            return state, metrics
+
+        trainer.train_step = tracing_step
+    return trainer, loop, data
+
+
+def cmd_run(args):
+    from mine_tpu.testing import faults
+    from mine_tpu.utils import make_logger
+
+    if args.faults and faults.ENV_VAR not in os.environ:
+        os.environ[faults.ENV_VAR] = args.faults
+    faults.activate()  # before the trainer: NaN injection is trace-time
+
+    logger = make_logger(None)
+    overrides = json.loads(args.config_overrides) if args.config_overrides \
+        else {}
+    _, loop, _ = make_loop(args.workspace, steps_file=args.steps_file,
+                           overrides=overrides, num_views=args.num_views,
+                           logger=logger)
+    loop.run(epochs=args.epochs)
+    print("preempted" if loop.preempted else "completed")
+    return 0
+
+
+def read_trace(path):
+    """steps file -> {step: repr_str}; later lines win (a resumed leg
+    replays the last checkpointed steps)."""
+    out = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    step, loss = line.split(",", 1)
+                    out[int(step)] = loss
+    return out
+
+
+def _leg_cmd(workspace, steps_file, epochs, num_views):
+    return [sys.executable, os.path.abspath(__file__), "run",
+            "--workspace", workspace, "--steps-file", steps_file,
+            "--epochs", str(epochs), "--num-views", str(num_views)]
+
+
+def cmd_soak(args):
+    import shutil
+    base = args.workspace
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+    ref_file = os.path.join(base, "ref_steps.txt")
+    chaos_file = os.path.join(base, "chaos_steps.txt")
+
+    print("== reference leg (uninterrupted) ==")
+    subprocess.run(_leg_cmd(os.path.join(base, "ref_ws"), ref_file,
+                            args.epochs, args.num_views), check=True)
+    ref = read_trace(ref_file)
+    print("reference: %d steps" % len(ref))
+
+    cycles = 0
+    while cycles < args.max_cycles:
+        cycles += 1
+        proc = subprocess.Popen(_leg_cmd(os.path.join(base, "chaos_ws"),
+                                         chaos_file, args.epochs,
+                                         args.num_views))
+        # SIGKILL once the leg has progressed a few steps past the last kill
+        target = len(read_trace(chaos_file)) + args.kill_after_steps
+        deadline = time.time() + args.leg_timeout
+        while proc.poll() is None and time.time() < deadline:
+            if len(read_trace(chaos_file)) >= target and cycles < args.kills:
+                os.kill(proc.pid, signal.SIGKILL)
+                break
+            time.sleep(0.2)
+        rc = proc.wait()
+        print("cycle %d: rc=%s, %d/%d steps traced"
+              % (cycles, rc, len(read_trace(chaos_file)), len(ref)))
+        if rc == 0:
+            break
+    chaos = read_trace(chaos_file)
+    bad = {s: (chaos.get(s), ref[s]) for s in ref if chaos.get(s) != ref[s]}
+    if bad or len(chaos) != len(ref):
+        print("DIVERGENCE after kill/resume:", dict(list(bad.items())[:5]))
+        return 1
+    print("soak OK: %d steps bitwise-identical across %d kill/resume cycles"
+          % (len(ref), cycles - 1))
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("run", help="one resumable training leg")
+    pr.add_argument("--workspace", required=True)
+    pr.add_argument("--steps-file", required=True)
+    pr.add_argument("--epochs", type=int, default=2)
+    pr.add_argument("--num-views", type=int, default=6)
+    pr.add_argument("--faults", default="",
+                    help="fault plan JSON (MINE_TPU_FAULTS env wins)")
+    pr.add_argument("--config-overrides", default="",
+                    help="JSON dict merged over the chaos fixture config")
+    pr.set_defaults(fn=cmd_run)
+
+    ps = sub.add_parser("soak", help="kill/resume cycles + bitwise check")
+    ps.add_argument("--workspace", required=True)
+    ps.add_argument("--epochs", type=int, default=2)
+    ps.add_argument("--num-views", type=int, default=6)
+    ps.add_argument("--kills", type=int, default=2,
+                    help="number of SIGKILL cycles before letting it finish")
+    ps.add_argument("--kill-after-steps", type=int, default=4)
+    ps.add_argument("--max-cycles", type=int, default=8)
+    ps.add_argument("--leg-timeout", type=float, default=900.0)
+    ps.set_defaults(fn=cmd_soak)
+
+    args = p.parse_args()
+    sys.exit(args.fn(args))
+
+
+if __name__ == "__main__":
+    main()

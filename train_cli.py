@@ -60,6 +60,12 @@ def main():
     if args.plane_parallel is not None:
         config["parallel.plane_parallel"] = args.plane_parallel
 
+    # chaos-test seams (testing.fault_plan / MINE_TPU_FAULTS env JSON);
+    # no-op in production. Must run before the trainer is constructed —
+    # the NaN-grad injection is resolved at trace time.
+    from mine_tpu.testing import faults
+    fault_plan = faults.activate(config)
+
     workspace = os.path.join(args.workspace, args.version)
     is_lead = jax.process_index() == 0
     if is_lead:
@@ -120,9 +126,19 @@ def main():
         state = state.replace(params=new_params, batch_stats=new_stats)
         logger.info("Loaded pretrained weights from %s", pretrained)
 
+    if fault_plan is not None:
+        logger.warning("FAULT INJECTION ACTIVE: %s", fault_plan)
+
     loop = TrainLoop(trainer, train_ds, val_ds, workspace,
                      logger=logger, tb_writer=tb_writer)
     loop.run(state)
+    if loop.preempted:
+        # clean preemption exit: the emergency checkpoint is on disk and a
+        # relaunch resumes exactly; exit 0 so supervisors treat this as a
+        # graceful drain, not a crash loop
+        logger.info("Exiting after preemption checkpoint — relaunch to "
+                    "resume")
+        sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -179,6 +179,19 @@ VARIANTS = {
     # the band-fit guard still applies and the in_domain stderr field
     # says which path each row actually timed.
     "warppass_b4": (4, {"training.warp_sep_tol": 1e6}),
+    # RENDER-ONLY SERVING row (not a train-step variant): one synthetic MPI
+    # encoded outside the timed region and cached (bf16), then
+    # RenderEngine.render — fused dequant + warp + composite, forward only,
+    # host round-trip included — timed once per warp backend (per-backend
+    # views/s on stderr; JSON ips = the platform's default warp path). The
+    # serve-side complement of warppass_b4: what one view request costs
+    # once its encode is resident (mine_tpu/serve; README "Serving").
+    "renderpass_b4": (4, {"training.warp_sep_tol": 1e6}),
+    # ENCODE-AMORTIZATION curve (not a train-step variant): views/s of
+    # (1 encode + v renders) for v = 1..64 — the economic case for the
+    # encode-once serving engine as one monotone parseable stderr line;
+    # JSON ips = the v=64 reading (its asymptote is renderpass throughput).
+    "serve_amortize": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -466,6 +479,183 @@ def _measure_warppass(name, steps=MEASURE_STEPS, keep_run=False):
     return sep_ips, sep_tflops, (sep_run if keep_run else None), batch_size
 
 
+def _serve_bench_engine(trainer, state, batch, max_bucket=8):
+    """(engine, image_id, encode_fn) for the serving-engine rows: one
+    synthetic MPI cached under the default bf16 quant, the engine wired the
+    way serve_cli wires it (composite backend by platform)."""
+    import jax
+
+    from mine_tpu.kernels import on_tpu_backend
+    from mine_tpu.serve import MPICache, RenderEngine
+    from mine_tpu.train.step import sample_disparity
+
+    cfg = trainer.cfg
+    batch_size = int(batch["src_img"].shape[0])
+    key = jax.random.fold_in(state.rng, state.step)
+    d_key, f_key, drop_key = jax.random.split(key, 3)
+    disparity = sample_disparity(d_key, batch_size, cfg)
+
+    def encode(img, disp):
+        return trainer.model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            img, disp, train=False)[0]
+
+    encode_jit = jax.jit(encode)
+    mpi = jax.block_until_ready(encode_jit(batch["src_img"], disparity))
+
+    engine = RenderEngine(
+        use_alpha=cfg.use_alpha,
+        is_bg_depth_inf=cfg.is_bg_depth_inf,
+        backend="pallas" if on_tpu_backend() else "xla",
+        warp_band=cfg.warp_band,
+        warp_sep_tol=cfg.warp_sep_tol,
+        max_bucket=max_bucket,
+        cache=MPICache(quant="bf16"))
+    image_id = "bench"
+    engine.put(image_id, mpi[0, :, 0:3], mpi[0, :, 3:4], disparity[0],
+               batch["K_src"][0])
+    return engine, image_id, encode_jit, (batch["src_img"], disparity), mpi
+
+
+def _serve_bench_poses(n):
+    """[n,4,4] small-translation poses — inside every banded backend's
+    correctness domain, like the video trajectories' near poses."""
+    import numpy as np
+    poses = np.tile(np.eye(4, dtype=np.float32), (n, 1, 1))
+    poses[:, 2, 3] = -0.02 * (np.arange(n) % 8)
+    return poses
+
+
+def _render_cost_tflops(engine, image_id, poses):
+    """HLO cost analysis of ONE bucketed render call (advisory)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu import geometry
+
+    entry = engine.cache.get(image_id)
+    planes, disp = entry.planes[None], entry.disparity[None]
+    K = entry.K[None]
+    scales = entry.scales[None] if entry.scales is not None else None
+    K_inv = geometry.inverse_intrinsics(K)
+    idx = jnp.zeros(poses.shape[0], jnp.int32)
+    try:
+        lowered = jax.jit(
+            engine._render_impl, static_argnames=("warp_impl",)).lower(
+            planes, scales, disp, K, K_inv, idx, jnp.asarray(poses),
+            warp_impl=engine.warp_impl)
+        return lowered.cost_analysis().get("flops", 0.0) / 1e12 or None
+    except Exception:
+        return None
+
+
+def _measure_renderpass(name, steps=MEASURE_STEPS, keep_run=False):
+    """Render-only serving forward (the renderpass_* variants).
+
+    The OTHER half of the encode/render split the serving engine monetizes:
+    one synthetic MPI is encoded outside the timed region and cached (bf16),
+    then each warp backend times `RenderEngine.render` — dequant + per-plane
+    homography warp + composite, forward only, through the engine's bucketed
+    jitted program, host round-trip included (what a serve request pays).
+    Per-backend views/s on stderr; the JSON ips is the engine's DEFAULT
+    warp path on this platform (pallas_diff on TPU, xla elsewhere)."""
+    from mine_tpu.kernels import on_tpu_backend
+
+    trainer, state, batch = build_variant_program(name)
+    batch_size = int(batch["src_img"].shape[0])
+    engine, image_id, _, _, _ = _serve_bench_engine(
+        trainer, state, batch, max_bucket=max(4, batch_size))
+    poses = _serve_bench_poses(batch_size)
+    default_impl = "pallas_diff" if on_tpu_backend() else "xla"
+
+    head_ips, head_tflops, head_run = None, None, None
+    for impl in WARPPASS_BACKENDS:
+        engine.render(image_id, poses, warp_impl=impl)  # compile + warm
+
+        def run(n, _impl=impl):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                engine.render(image_id, poses, warp_impl=_impl)
+            # engine.render returns numpy: every call already round-trips
+            return time.perf_counter() - t0
+
+        dt = run(steps)
+        ips = batch_size * steps / dt
+        print("  renderpass[%s]: %d render-only calls of %d poses in %.3fs "
+              "(%.2f ms/call, %.3f views/s)%s"
+              % (impl, steps, batch_size, dt, 1e3 * dt / steps, ips,
+                 " [default]" if impl == default_impl else ""),
+              file=sys.stderr)
+        if impl == default_impl:
+            engine.warp_impl = impl
+            head_ips, head_run = ips, run
+            head_tflops = _render_cost_tflops(engine, image_id, poses)
+    return head_ips, head_tflops, (head_run if keep_run else None), batch_size
+
+
+# views-per-encode sweep of the amortization row (pow2 so every render
+# decomposes into already-compiled buckets)
+SERVE_AMORTIZE_VIEWS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
+    """Encode-amortization curve (the serve_amortize variant).
+
+    For each v in the sweep, time ONE full encode (model forward + cache
+    put) plus v engine renders, and report v / t as views/s. The curve is
+    v/(t_enc + v*t_render) — monotonically increasing by construction, and
+    its asymptote is the render-only throughput: the number the encode-once
+    architecture is buying. Printed as one parseable stderr line
+    ("serve_amortize curve: v:views_per_sec ..."); JSON ips is the v=64
+    reading, tflops_per_step the full v=64 trial (1 encode + 64 renders)
+    with batch=64 so the physics audit prices the whole trial."""
+    import jax
+
+    trainer, state, batch = build_variant_program(name)
+    max_bucket = 8
+    engine, image_id, encode_jit, enc_args, mpi = _serve_bench_engine(
+        trainer, state, batch, max_bucket=max_bucket)
+    img, disparity = enc_args
+    repeats = 1 if SMOKE else 3
+
+    engine.warmup(image_id)  # pre-compile every pose bucket <= max_bucket
+
+    def one_trial(v):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(encode_jit(img, disparity))
+        engine.put(image_id, out[0, :, 0:3], out[0, :, 3:4], disparity[0],
+                   batch["K_src"][0])
+        engine.render(image_id, _serve_bench_poses(v))
+        return time.perf_counter() - t0
+
+    curve = []
+    for v in SERVE_AMORTIZE_VIEWS:
+        t = min(one_trial(v) for _ in range(repeats))
+        curve.append((v, v / t))
+    print("  serve_amortize curve: "
+          + " ".join("%d:%.3f" % (v, ips) for v, ips in curve)
+          + "  (views/s per single-image encode)", file=sys.stderr)
+
+    v_max = SERVE_AMORTIZE_VIEWS[-1]
+    tflops = None
+    try:
+        enc_tflops = encode_jit.lower(
+            img, disparity).cost_analysis().get("flops", 0.0) / 1e12
+        render_tflops = _render_cost_tflops(
+            engine, image_id, _serve_bench_poses(max_bucket)) or 0.0
+        tflops = enc_tflops + render_tflops * (v_max // max_bucket) or None
+    except Exception:
+        pass
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one_trial(v_max)
+        return time.perf_counter() - t0
+
+    return curve[-1][1], tflops, (run if keep_run else None), v_max
+
+
 def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
     """training.ssim_precision A/B (the ssim_precision_ab variants).
 
@@ -502,6 +692,10 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
         return _measure_realloop(name, steps=steps, keep_run=keep_run)
     if name.startswith("warppass"):
         return _measure_warppass(name, steps=steps, keep_run=keep_run)
+    if name.startswith("renderpass"):
+        return _measure_renderpass(name, steps=steps, keep_run=keep_run)
+    if name.startswith("serve_amortize"):
+        return _measure_serve_amortize(name, steps=steps, keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):

@@ -198,6 +198,67 @@ def resilience_config_from_dict(config: Dict[str, Any]) -> ResilienceConfig:
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Render-only serving knobs (mine_tpu/serve; README "Serving").
+
+    Host-side policy plus trace-time shape/quant choices — nothing here
+    changes the numerics of the bf16/float32 render paths (bf16 dequant is
+    a widening cast; serve/cache.py)."""
+    # serve.cache_bytes: LRU byte budget for cached quantized MPI planes
+    # (0 = unbounded)
+    cache_bytes: int = 0
+    # serve.cache_quant: float32 | bf16 | int8 cache storage (serve/cache.py)
+    cache_quant: str = "bf16"
+    # serve.max_bucket: poses per device call; pose counts pad to
+    # power-of-two buckets <= this, bounding the compile set
+    max_bucket: int = 8
+    # serve.max_requests / serve.max_wait_ms: micro-batcher coalescing
+    # (serve/batcher.py)
+    max_requests: int = 8
+    max_wait_ms: float = 2.0
+    # serve.eval_encode_once: eval loop encodes each DISTINCT source image
+    # once and reuses the cached MPI pyramid for all its target views
+    # (single-host, num_bins_fine=0; train/loop.py run_eval)
+    eval_encode_once: bool = False
+    # serve.eval_cache_quant: quantization of the eval-loop encode cache;
+    # float32 (default) keeps metric parity with the per-pair path exact
+    eval_cache_quant: str = "float32"
+
+
+def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
+    g = config.get
+    out = ServeConfig(
+        cache_bytes=int(g("serve.cache_bytes", 0) or 0),
+        cache_quant=str(g("serve.cache_quant", "bf16")),
+        max_bucket=int(g("serve.max_bucket", 8)),
+        max_requests=int(g("serve.max_requests", 8)),
+        max_wait_ms=float(g("serve.max_wait_ms", 2.0)),
+        eval_encode_once=bool(g("serve.eval_encode_once", False)),
+        eval_cache_quant=str(g("serve.eval_cache_quant", "float32")),
+    )
+    from mine_tpu.serve.cache import QUANT_MODES
+    for key, val in (("serve.cache_quant", out.cache_quant),
+                     ("serve.eval_cache_quant", out.eval_cache_quant)):
+        if val not in QUANT_MODES:
+            raise ValueError(
+                f"{key} must be one of {'|'.join(QUANT_MODES)}, got {val!r}")
+    if out.cache_bytes < 0:
+        raise ValueError(
+            f"serve.cache_bytes must be >= 0, got {out.cache_bytes}")
+    if out.max_bucket < 1 or (out.max_bucket & (out.max_bucket - 1)) != 0:
+        raise ValueError(
+            f"serve.max_bucket must be a power of two >= 1, "
+            f"got {out.max_bucket}")
+    if out.max_requests < 1:
+        raise ValueError(
+            f"serve.max_requests must be >= 1, got {out.max_requests}")
+    if out.max_wait_ms < 0:
+        raise ValueError(
+            f"serve.max_wait_ms must be >= 0, got {out.max_wait_ms}")
+    return out
+
+
 # Datasets for which the sparse-3D-point disparity loss and scale factor are
 # disabled (reference: synthesis_task.py:213-214,297).
 _NO_DISP_DATASETS = ("flowers", "kitti_raw", "dtu")

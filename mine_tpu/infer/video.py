@@ -5,8 +5,13 @@ render a camera trajectory by re-running only the warp+composite per pose
 (VideoGenerator: infer once :112-153, render per frame :219-255).
 
 TPU-first difference: poses are rendered in jitted *batches* (the pose axis is
-just a batch axis of the warp), not one python-loop frame at a time — one
-compile, then every chunk of frames is a single device call.
+just a batch axis of the warp), not one python-loop frame at a time. The
+batched render itself lives in the serving engine (mine_tpu/serve): this
+class encodes the image, caches the blended MPI in the engine's cache, and
+drives `RenderEngine.render` per trajectory — the same compile-once,
+render-only program the serving path uses. The default float32 cache keeps
+frames bitwise-identical to the pre-engine private chunk loop
+(tests/test_serve.py gates this).
 
 Videos are written with imageio(+ffmpeg) when available, else PNG frames —
 moviepy (the reference's writer) is not in this image.
@@ -14,9 +19,8 @@ moviepy (the reference's writer) is not in this image.
 
 from __future__ import annotations
 
-import math
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -24,10 +28,10 @@ import jax
 import jax.numpy as jnp
 
 from mine_tpu import geometry
-from mine_tpu.config import (MPIConfig, mpi_config_from_dict,
-                             validate_model_shapes)
+from mine_tpu.config import mpi_config_from_dict, validate_model_shapes
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering
+from mine_tpu.serve import MPICache, RenderEngine, image_id_for
 from mine_tpu.train.step import sample_disparity
 from mine_tpu.utils import disparity_normalization_vis
 
@@ -114,7 +118,9 @@ class VideoGenerator:
                  chunk: int = 8,
                  dtype=jnp.bfloat16,
                  seed: int = 0,
-                 backend: Optional[str] = None):
+                 backend: Optional[str] = None,
+                 engine: Optional[RenderEngine] = None,
+                 cache_quant: str = "float32"):
         self.cfg = mpi_config_from_dict(config)
         validate_model_shapes(self.cfg)
         self.config = config
@@ -165,31 +171,21 @@ class VideoGenerator:
             self.mpi_rgb = blend_weights * src_nchw[:, None] + \
                 (1.0 - blend_weights) * rgb
         self.mpi_sigma = sigma
-        self._xyz_src = xyz_src
 
-        self._render_chunk = jax.jit(self._render_chunk_impl,
-                                     static_argnames=("warp_impl",))
-
-    def _render_chunk_impl(self, G_tgt_src_F44, warp_impl: str):
-        """Render F poses at once: the pose axis is the batch axis."""
-        F = G_tgt_src_F44.shape[0]
-
-        def tile(x):
-            return jnp.broadcast_to(x, (F,) + x.shape[1:])
-
-        xyz_tgt = geometry.plane_xyz_tgt(tile(self._xyz_src), G_tgt_src_F44)
-        res = rendering.render_tgt_rgb_depth(
-            tile(self.mpi_rgb), tile(self.mpi_sigma),
-            tile(self.disparity), xyz_tgt, G_tgt_src_F44,
-            tile(self.K_inv), tile(self.K),
-            use_alpha=self.cfg.use_alpha,
-            is_bg_depth_inf=self.cfg.is_bg_depth_inf,
-            backend=self.backend,
-            warp_impl=warp_impl,
-            warp_band=WARP_BAND)
-        # floor matches the loss graph's safe inversion: fully-transparent
-        # pixels composite to depth 0 and would otherwise make inf frames
-        return res.rgb, 1.0 / jnp.maximum(res.depth, 1e-8)
+        # hand the encode to the serving engine's cache; trajectories render
+        # through its bucketed jitted program (one compile set per warp impl)
+        if engine is None:
+            engine = RenderEngine(
+                use_alpha=self.cfg.use_alpha,
+                is_bg_depth_inf=self.cfg.is_bg_depth_inf,
+                backend=self.backend,
+                warp_band=WARP_BAND,
+                max_bucket=chunk,
+                cache=MPICache(quant=cache_quant))
+        self.engine = engine
+        self.image_id = image_id_for(np.asarray(self.img))
+        engine.put(self.image_id, self.mpi_rgb[0], self.mpi_sigma[0],
+                   self.disparity[0], self.K[0])
 
     def _max_row_block_span(self, poses_F44: np.ndarray,
                             rows_per_block: int = 8, step: int = 8) -> float:
@@ -241,23 +237,12 @@ class VideoGenerator:
             slack = _align_slack(WARP_BAND, int(self.cfg.img_h))
             if span + 4 + slack <= WARP_BAND:
                 warp_impl = "pallas"
-        F = poses_F44.shape[0]
-        rgbs, disps = [], []
-        for i in range(0, F, self.chunk):
-            chunk = poses_F44[i:i + self.chunk]
-            pad = 0
-            if chunk.shape[0] < self.chunk:  # keep jit shape static
-                pad = self.chunk - chunk.shape[0]
-                chunk = np.concatenate(
-                    [chunk, np.tile(np.eye(4, dtype=np.float32),
-                                    (pad, 1, 1))], axis=0)
-            rgb, disp = self._render_chunk(jnp.asarray(chunk), warp_impl)
-            rgb, disp = np.asarray(rgb), np.asarray(disp)
-            if pad:
-                rgb, disp = rgb[:-pad], disp[:-pad]
-            rgbs.append(rgb)
-            disps.append(disp)
-        return np.concatenate(rgbs), np.concatenate(disps)
+        rgb, depth = self.engine.render(
+            self.image_id, np.asarray(poses_F44, np.float32),
+            warp_impl=warp_impl)
+        # floor matches the loss graph's safe inversion: fully-transparent
+        # pixels composite to depth 0 and would otherwise make inf frames
+        return rgb, np.float32(1.0) / np.maximum(depth, np.float32(1e-8))
 
     def render_videos(self, output_dir: str, output_name: str) -> List[str]:
         trajectories, meta = generate_trajectories(self.config.get("data.name",

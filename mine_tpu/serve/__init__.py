@@ -1,0 +1,24 @@
+"""Encode-once MPI serving: quantized plane cache + render-only engine.
+
+MINE predicts an MPI once per image; every novel view after that is warp +
+composite only. This package is the serving-side realization of that
+asymmetry (README "Serving"):
+
+  cache.py    MPICache — LRU of quantized MPI planes under a byte budget
+  engine.py   RenderEngine — shape-bucketed jitted render-only program
+  batcher.py  MicroBatcher — coalesces requests across distinct MPIs
+
+Configured by the serve.* keys (configs/params_default.yaml,
+config.ServeConfig).
+"""
+
+from mine_tpu.serve.batcher import MicroBatcher
+from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
+                                  dequantize_planes, image_id_for,
+                                  quantize_planes)
+from mine_tpu.serve.engine import RenderEngine, pow2_bucket
+
+__all__ = [
+    "MPICache", "MPIEntry", "MicroBatcher", "PyramidCache", "RenderEngine",
+    "dequantize_planes", "image_id_for", "pow2_bucket", "quantize_planes",
+]

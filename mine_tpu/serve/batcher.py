@@ -1,0 +1,101 @@
+"""Micro-batcher: coalesce pending view requests into one device call.
+
+Serving traffic arrives as independent (image_id, pose) requests, usually
+against DIFFERENT cached MPIs. Dispatching each alone wastes the batch axis;
+this batcher holds a request up to `max_wait_ms`, coalesces everything
+pending (across distinct entries — the engine's request-gather handles the
+mapping) and flushes one `RenderEngine.render_many` call of at most
+`max_requests`. Results come back through per-request futures.
+
+Thread model: callers `submit` from any thread; a single daemon flush thread
+owns the device dispatch, so the engine's jitted call never races. Tests
+drive `flush()` directly with `start=False` (no timing dependence).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from mine_tpu.serve.engine import RenderEngine
+
+
+class MicroBatcher:
+    def __init__(self, engine: RenderEngine,
+                 max_requests: int = 8,
+                 max_wait_ms: float = 2.0,
+                 start: bool = True):
+        if max_requests < 1:
+            raise ValueError(f"max_requests must be >= 1, got {max_requests}")
+        self.engine = engine
+        self.max_requests = int(max_requests)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.flushes = 0
+        self._cv = threading.Condition()
+        self._pending: List[Tuple[str, np.ndarray, Future]] = []
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="mine-tpu-serve-batcher")
+            self._thread.start()
+
+    def submit(self, image_id: str, pose_44: np.ndarray) -> Future:
+        """Enqueue one view request; resolves to (rgb [3,H,W],
+        depth [1,H,W]) f32 numpy."""
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._pending.append(
+                (image_id, np.asarray(pose_44, np.float32), fut))
+            self._cv.notify()
+        return fut
+
+    def flush(self) -> int:
+        """Dispatch up to max_requests pending requests in ONE device call;
+        returns how many were served (0 = nothing pending)."""
+        with self._cv:
+            batch = self._pending[:self.max_requests]
+            del self._pending[:len(batch)]
+        if not batch:
+            return 0
+        try:
+            results = self.engine.render_many(
+                [(i, p) for i, p, _ in batch])
+            self.flushes += 1
+            for (_, _, fut), res in zip(batch, results):
+                fut.set_result(res)
+        except Exception as e:  # pragma: no cover - device failures
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+        return len(batch)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                # first request in: linger up to max_wait_s for co-riders
+                # unless a full batch is already there (max_wait_ms=0
+                # flushes immediately)
+                if (self.max_wait_s > 0 and not self._closed
+                        and len(self._pending) < self.max_requests):
+                    self._cv.wait(timeout=self.max_wait_s)
+            self.flush()
+
+    def close(self) -> None:
+        """Drain pending requests, then stop the flush thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        while self.flush():
+            pass

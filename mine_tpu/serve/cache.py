@@ -1,0 +1,226 @@
+"""Encode-once MPI cache: quantized plane storage under a byte budget.
+
+MINE's economic property is that one encoder-decoder pass yields an MPI from
+which arbitrarily many views render by warp+composite alone. Serving many
+views per image therefore wants the encode result RESIDENT — this module is
+that residency layer: an LRU keyed by image id under a byte budget, planes
+stored quantized so the cache holds 2-4x more scenes per GB of HBM/RAM.
+
+Quantization modes (serve.cache_quant):
+  float32  no compression (exact; the eval-parity default)
+  bf16     planes cast to bfloat16 (default). Dequant (astype f32) is a
+           WIDENING cast — every bf16 value is exactly representable in
+           f32 — so dequantization is deterministic and bit-stable: the
+           rendered view from a bf16 cache entry is bitwise-identical to
+           rendering from the host-dequantized planes (tests/test_serve.py).
+  int8     symmetric per-plane-per-channel int8 with f32 scales:
+           scale[s,c] = max|x[s,c]| / 127, q = round(x/scale). The absolute
+           dequant error is bounded by scale/2 = max|x|/254 per (plane,
+           channel) — documented AND test-enforced (tests/test_serve.py).
+
+Dequantization is fused into the serving engine's jitted render program
+(serve/engine.py), so the cache-resident form is what crosses HBM.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+QUANT_MODES = ("float32", "bf16", "int8")
+
+
+def image_id_for(img: np.ndarray) -> str:
+    """Content-addressed cache key: sha1 of the raw image bytes (no dataset
+    cooperation needed — two requests for the same pixels share an entry)."""
+    arr = np.ascontiguousarray(np.asarray(img))
+    return hashlib.sha1(arr.tobytes()).hexdigest()
+
+
+def quantize_planes(planes_SCHW: jnp.ndarray,
+                    quant: str) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """f32 [S,C,H,W] planes -> (stored array, scales|None).
+
+    int8 scales are [S,C,1,1] f32 (symmetric, zero-point-free); the all-zero
+    plane guard keeps scale finite so 0 round-trips to exactly 0.
+    """
+    if quant not in QUANT_MODES:
+        raise ValueError(f"quant must be one of {QUANT_MODES}, got {quant!r}")
+    planes = jnp.asarray(planes_SCHW, jnp.float32)
+    if quant == "float32":
+        return planes, None
+    if quant == "bf16":
+        return planes.astype(jnp.bfloat16), None
+    amax = jnp.max(jnp.abs(planes), axis=(-1, -2), keepdims=True)  # [S,C,1,1]
+    scales = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(planes / scales), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_planes(stored: jnp.ndarray,
+                      scales: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Inverse of quantize_planes; always f32 out. Mirrors the in-jit dequant
+    of serve/engine.py (kept in sync by the engine parity tests)."""
+    x = stored.astype(jnp.float32)
+    if stored.dtype == jnp.int8:
+        if scales is None:
+            raise ValueError("int8 planes need their scales")
+        x = x * scales
+    return x
+
+
+class MPIEntry(NamedTuple):
+    """One cached encode: quantized planes + the geometry to render them."""
+    planes: jnp.ndarray            # [S,4,H,W] rgb+sigma, f32/bf16/int8
+    scales: Optional[jnp.ndarray]  # [S,4,1,1] f32 (int8 only, else None)
+    disparity: jnp.ndarray         # [S] f32 plane disparities
+    K: jnp.ndarray                 # [3,3] f32 source intrinsics
+    nbytes: int
+
+    def dequantized(self) -> jnp.ndarray:
+        return dequantize_planes(self.planes, self.scales)
+
+
+def _entry_nbytes(entry_arrays) -> int:
+    return int(sum(np.dtype(a.dtype).itemsize * int(np.prod(a.shape))
+                   for a in entry_arrays if a is not None))
+
+
+class MPICache:
+    """LRU over MPIEntry under `capacity_bytes` (0 = unbounded).
+
+    get() refreshes recency; put() evicts least-recently-used entries until
+    the new total fits (a single entry larger than the budget still stores —
+    it just evicts everything else first). hits/misses/evictions counters
+    feed serve_cli's stats line and the amortization bench.
+    """
+
+    def __init__(self, capacity_bytes: int = 0, quant: str = "bf16"):
+        if quant not in QUANT_MODES:
+            raise ValueError(
+                f"quant must be one of {QUANT_MODES}, got {quant!r}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.quant = quant
+        self._entries: "OrderedDict[str, MPIEntry]" = OrderedDict()
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, image_id: str) -> bool:
+        return image_id in self._entries
+
+    def keys(self):
+        """Ids in eviction order (least-recently-used first)."""
+        return list(self._entries.keys())
+
+    def put(self, image_id: str,
+            mpi_rgb_S3HW: jnp.ndarray,
+            mpi_sigma_S1HW: jnp.ndarray,
+            disparity_S: jnp.ndarray,
+            K_33: jnp.ndarray) -> MPIEntry:
+        planes = jnp.concatenate(
+            [jnp.asarray(mpi_rgb_S3HW, jnp.float32),
+             jnp.asarray(mpi_sigma_S1HW, jnp.float32)], axis=1)  # [S,4,H,W]
+        stored, scales = quantize_planes(planes, self.quant)
+        disparity = jnp.asarray(disparity_S, jnp.float32)
+        K = jnp.asarray(K_33, jnp.float32)
+        entry = MPIEntry(
+            planes=stored, scales=scales, disparity=disparity, K=K,
+            nbytes=_entry_nbytes((stored, scales, disparity, K)))
+        old = self._entries.pop(image_id, None)
+        if old is not None:
+            self.nbytes -= old.nbytes
+        self._entries[image_id] = entry
+        self.nbytes += entry.nbytes
+        if self.capacity_bytes > 0:
+            while self.nbytes > self.capacity_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self.nbytes -= evicted.nbytes
+                self.evictions += 1
+        return entry
+
+    def get(self, image_id: str) -> Optional[MPIEntry]:
+        entry = self._entries.get(image_id)
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._entries.move_to_end(image_id)
+        return entry
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "nbytes": self.nbytes,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "quant": self.quant}
+
+
+class PyramidCache:
+    """Eval-loop sibling of MPICache: caches one encode's FULL multi-scale
+    MPI pyramid (per-scale [S,4,h,w] plane volumes) plus the disparity row
+    the encode was conditioned on.
+
+    The eval loop (train/loop.py run_eval, serve.eval_encode_once) encodes
+    each distinct source image once and replays the pyramid for every
+    (src, tgt) pair; the loss consumes all scales, so the whole pyramid is
+    the cache unit (one entry evicts atomically — no partial pyramids).
+    Same LRU/byte-budget/quantization semantics as MPICache.
+    """
+
+    def __init__(self, capacity_bytes: int = 0, quant: str = "float32"):
+        if quant not in QUANT_MODES:
+            raise ValueError(
+                f"quant must be one of {QUANT_MODES}, got {quant!r}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.quant = quant
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, image_id: str) -> bool:
+        return image_id in self._entries
+
+    def put(self, image_id: str, mpi_list, disparity_S) -> None:
+        stored = [quantize_planes(m, self.quant) for m in mpi_list]
+        disparity = jnp.asarray(disparity_S, jnp.float32)
+        nbytes = _entry_nbytes(
+            [a for pair in stored for a in pair] + [disparity])
+        old = self._entries.pop(image_id, None)
+        if old is not None:
+            self.nbytes -= old[2]
+        self._entries[image_id] = (stored, disparity, nbytes)
+        self.nbytes += nbytes
+        if self.capacity_bytes > 0:
+            while self.nbytes > self.capacity_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self.nbytes -= evicted[2]
+                self.evictions += 1
+
+    def get(self, image_id: str):
+        """-> (per-scale dequantized f32 volumes, disparity [S]) or None."""
+        entry = self._entries.get(image_id)
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._entries.move_to_end(image_id)
+        stored, disparity, _ = entry
+        return [dequantize_planes(q, s) for q, s in stored], disparity
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "nbytes": self.nbytes,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "quant": self.quant}

@@ -1,0 +1,253 @@
+"""Render-only serving engine: cached quantized MPIs -> novel views.
+
+Decouples MPI *prediction* (the expensive encoder-decoder pass) from view
+*synthesis* (warp + composite, exactly the `render_tgt_rgb_depth` math). One
+jitted program renders P poses from R cached MPIs in a single device call:
+
+    planes [R,S,4,H,W] (quantized)   ──dequant──┐
+    disparity [R,S], K/K_inv [R,3,3] ──xyz_src──┤ gather by idx [P]
+    idx [P] int32, G_tgt_src [P,4,4] ───────────┴─> render_tgt_rgb_depth
+                                                    -> rgb [P,3,H,W], depth
+
+Pose and entry counts are padded to power-of-two buckets (identity poses /
+repeated entries, results sliced back), so the compile set is BOUNDED by
+log2(max_bucket) x log2(max_requests) per (shape, quant, warp_impl) instead
+of one executable per request size; `warmup` pre-traces the buckets through
+the persistent compile cache (utils.configure_compile_cache). Every op in
+the program is per-batch-row independent (einsums over the batch dim,
+gather, elementwise, cumprod over S), so padding does not perturb real rows
+— the engine parity tests assert this bitwise on CPU.
+
+Dequantization is fused into the jitted program: the cache-resident form
+(bf16 / int8, serve/cache.py) is what crosses HBM, and the bf16 widening
+cast keeps the render bitwise-identical to rendering host-dequantized
+planes.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mine_tpu import geometry
+from mine_tpu.ops import rendering
+from mine_tpu.serve.cache import MPICache, MPIEntry, image_id_for
+
+_warned_sync_encode = set()
+
+
+def _warn_sync_encode(engine_key, image_id: str) -> None:
+    """One-time notice that a serve request missed the cache and forced a
+    synchronous encode — the slow path must be visible in logs (same
+    pattern as ops/rendering._warn_backend_fallback)."""
+    if engine_key not in _warned_sync_encode:
+        _warned_sync_encode.add(engine_key)
+        warnings.warn(
+            f"serve cache miss for image {image_id[:12]}…: running a "
+            f"SYNCHRONOUS encode on the request path (pre-encode via "
+            f"RenderEngine.put/encode to keep serving render-only)")
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (>=1): the static-shape bucket a request
+    count pads to, so the compile set grows with log2 of the largest batch
+    ever seen instead of one executable per batch size."""
+    if n < 1:
+        raise ValueError(f"need at least one element, got {n}")
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _identity_poses(n: int) -> np.ndarray:
+    return np.tile(np.eye(4, dtype=np.float32), (n, 1, 1))
+
+
+class RenderEngine:
+    """Shape-bucketed jitted render over an encode-once MPI cache.
+
+    Single-MPI path (`render`): chunk P poses through `max_bucket`-sized
+    device calls (the video generator's path). Multi-MPI path
+    (`render_many`): coalesce requests against DISTINCT cached entries into
+    one call (the micro-batcher's flush path, serve/batcher.py).
+    """
+
+    def __init__(self,
+                 use_alpha: bool = False,
+                 is_bg_depth_inf: bool = False,
+                 backend: str = "xla",
+                 warp_impl: str = "xla",
+                 warp_band: int = 48,
+                 warp_dtype: str = "float32",
+                 warp_sep_tol: float = 0.5,
+                 max_bucket: int = 8,
+                 cache: Optional[MPICache] = None,
+                 encode_fn: Optional[Callable] = None):
+        if max_bucket < 1 or (max_bucket & (max_bucket - 1)) != 0:
+            raise ValueError(
+                f"serve.max_bucket must be a power of two >= 1, "
+                f"got {max_bucket}")
+        self.use_alpha = use_alpha
+        self.is_bg_depth_inf = is_bg_depth_inf
+        self.backend = backend
+        self.warp_impl = warp_impl
+        self.warp_band = warp_band
+        self.warp_dtype = warp_dtype
+        self.warp_sep_tol = warp_sep_tol
+        self.max_bucket = max_bucket
+        self.cache = cache if cache is not None else MPICache()
+        # encode_fn(img_hwc) -> (mpi_rgb [S,3,H,W], mpi_sigma [S,1,H,W],
+        # disparity [S], K [3,3]) — the synchronous fallback for cache
+        # misses; None keeps the engine strictly render-only (miss raises)
+        self.encode_fn = encode_fn
+        self.device_calls = 0
+        self._render = jax.jit(self._render_impl,
+                               static_argnames=("warp_impl",))
+
+    # ---------------- cache facade ----------------
+
+    def put(self, image_id: str, mpi_rgb_S3HW, mpi_sigma_S1HW,
+            disparity_S, K_33) -> MPIEntry:
+        return self.cache.put(image_id, mpi_rgb_S3HW, mpi_sigma_S1HW,
+                              disparity_S, K_33)
+
+    def encode(self, img_hwc: np.ndarray,
+               image_id: Optional[str] = None) -> str:
+        """Encode an image through `encode_fn` and cache the MPI; returns
+        the cache key (content hash unless given)."""
+        if self.encode_fn is None:
+            raise ValueError("RenderEngine has no encode_fn")
+        if image_id is None:
+            image_id = image_id_for(img_hwc)
+        if image_id not in self.cache:
+            self.cache.put(image_id, *self.encode_fn(img_hwc))
+        return image_id
+
+    def _entry(self, image_id: str, image=None) -> MPIEntry:
+        entry = self.cache.get(image_id)
+        if entry is not None:
+            return entry
+        if self.encode_fn is None or image is None:
+            raise KeyError(
+                f"image {image_id[:12]}… not cached and no synchronous "
+                f"encode path (pass image= and set encode_fn)")
+        _warn_sync_encode(id(self), image_id)
+        return self.cache.put(image_id, *self.encode_fn(image))
+
+    # ---------------- jitted render ----------------
+
+    def _render_impl(self, planes, scales, disp, K, K_inv, idx, G,
+                     warp_impl: str):
+        """planes [R,S,4,H,W] (quantized) + request gather idx [P] +
+        poses G [P,4,4] -> (rgb [P,3,H,W], depth [P,1,H,W])."""
+        x = planes.astype(jnp.float32)
+        if planes.dtype == jnp.int8:
+            x = x * scales  # fused dequant: int8 never leaves this program
+        rgb = x[:, :, 0:3]
+        sigma = x[:, :, 3:4]
+        H, W = x.shape[-2], x.shape[-1]
+        grid = geometry.cached_pixel_grid(H, W)
+        xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)  # [R,S,3,H,W]
+        xyz_tgt = geometry.plane_xyz_tgt(xyz_src[idx], G)
+        res = rendering.render_tgt_rgb_depth(
+            rgb[idx], sigma[idx], disp[idx], xyz_tgt, G,
+            K_inv[idx], K[idx],
+            use_alpha=self.use_alpha,
+            is_bg_depth_inf=self.is_bg_depth_inf,
+            backend=self.backend,
+            warp_impl=warp_impl,
+            warp_band=self.warp_band,
+            warp_dtype=self.warp_dtype,
+            warp_sep_tol=self.warp_sep_tol)
+        return res.rgb, res.depth
+
+    def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
+              poses: np.ndarray, warp_impl: Optional[str]):
+        """Bucket R and P, pad, dispatch ONE device call, slice."""
+        warp_impl = warp_impl or self.warp_impl
+        P = poses.shape[0]
+        Pb = pow2_bucket(P)
+        if P < Pb:
+            poses = np.concatenate([poses, _identity_poses(Pb - P)], axis=0)
+            idx = np.concatenate([idx, np.zeros(Pb - P, idx.dtype)])
+        R = len(entries)
+        Rb = pow2_bucket(R)
+        planes = jnp.stack([e.planes for e in entries])
+        disp = jnp.stack([e.disparity for e in entries])
+        K = jnp.stack([e.K for e in entries])
+        scales = None
+        if entries[0].scales is not None:
+            scales = jnp.stack([e.scales for e in entries])
+        if R < Rb:
+            # pad by repeating entry 0: all-valid data, never gathered
+            def pad_r(a):
+                return jnp.concatenate(
+                    [a, jnp.broadcast_to(a[:1], (Rb - R,) + a.shape[1:])])
+            planes, disp, K = pad_r(planes), pad_r(disp), pad_r(K)
+            if scales is not None:
+                scales = pad_r(scales)
+        K_inv = geometry.inverse_intrinsics(K)
+        rgb, depth = self._render(planes, scales, disp, K, K_inv,
+                                  jnp.asarray(idx, jnp.int32),
+                                  jnp.asarray(poses), warp_impl)
+        self.device_calls += 1
+        return np.asarray(rgb[:P]), np.asarray(depth[:P])
+
+    # ---------------- public render paths ----------------
+
+    def render(self, image_id: str, poses_P44: np.ndarray,
+               warp_impl: Optional[str] = None,
+               image=None) -> Tuple[np.ndarray, np.ndarray]:
+        """All P poses against ONE cached MPI -> (rgb [P,3,H,W],
+        depth [P,1,H,W]) f32 numpy. Full max_bucket chunks, then one
+        pow2-bucketed remainder call."""
+        entry = self._entry(image_id, image=image)
+        poses = np.asarray(poses_P44, np.float32)
+        P = poses.shape[0]
+        rgbs, depths = [], []
+        for i in range(0, P, self.max_bucket):
+            chunk = poses[i:i + self.max_bucket]
+            rgb, depth = self._call(
+                [entry], np.zeros(chunk.shape[0], np.int32), chunk, warp_impl)
+            rgbs.append(rgb)
+            depths.append(depth)
+        return np.concatenate(rgbs), np.concatenate(depths)
+
+    def render_many(self, requests: Sequence[Tuple[str, np.ndarray]],
+                    warp_impl: Optional[str] = None
+                    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Coalesced path: [(image_id, pose [4,4])...] across DISTINCT
+        cached MPIs -> one device call; per-request (rgb, depth) in order."""
+        if not requests:
+            return []
+        order: List[str] = []
+        for image_id, _ in requests:
+            if image_id not in order:
+                order.append(image_id)
+        entries = [self._entry(i) for i in order]
+        idx = np.asarray([order.index(i) for i, _ in requests], np.int32)
+        poses = np.stack([np.asarray(p, np.float32) for _, p in requests])
+        rgb, depth = self._call(entries, idx, poses, warp_impl)
+        return [(rgb[j], depth[j]) for j in range(len(requests))]
+
+    def warmup(self, image_id: str,
+               pose_counts: Optional[Sequence[int]] = None,
+               warp_impl: Optional[str] = None) -> None:
+        """Pre-trace the bucketed programs against a cached entry, through
+        JAX's persistent compile cache (utils.configure_compile_cache) so a
+        restarted server skips the compiles entirely."""
+        from mine_tpu.utils import configure_compile_cache
+        configure_compile_cache()
+        if pose_counts is None:
+            pose_counts, b = [], 1
+            while b <= self.max_bucket:
+                pose_counts.append(b)
+                b *= 2
+        for n in pose_counts:
+            self.render(image_id, _identity_poses(n), warp_impl=warp_impl)

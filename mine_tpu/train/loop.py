@@ -16,18 +16,21 @@ import time
 from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from mine_tpu.config import resilience_config_from_dict
+from mine_tpu.config import (resilience_config_from_dict,
+                             serve_config_from_dict)
 from mine_tpu.data.common import PIPELINE_STATS, RetryPolicy, set_retry_policy
 # prefetch is re-exported here for backward compatibility; it moved to the
 # input-pipeline module alongside the threaded assembler + device stager
 from mine_tpu.data.pipeline import DeviceStager, StagedBatch, prefetch  # noqa: F401
+from mine_tpu.serve import PyramidCache, image_id_for
 from mine_tpu.testing import faults
 from mine_tpu.train import resilience
 from mine_tpu.train.checkpoint import CheckpointManager
 from mine_tpu.train.state import TrainState, current_lrs
-from mine_tpu.train.step import SynthesisTrainer
+from mine_tpu.train.step import SynthesisTrainer, sample_disparity
 from mine_tpu.utils import AverageMeter, disparity_normalization_vis, metrics_to_float
 
 TRAIN_METER_KEYS = ("loss", "loss_rgb_src", "loss_ssim_src",
@@ -108,6 +111,28 @@ class TrainLoop:
         # split across hosts); the jitted step sees the global batch
         self.local_batch_size = trainer.local_batch_size()
         self.seed = int(self.config.get("training.seed", 0))
+
+        # --- encode-once eval (serve.eval_encode_once; README "Serving") ---
+        # Encode each DISTINCT val source image once per eval and replay its
+        # cached MPI pyramid for every target view — the eval-loop face of
+        # the serving engine's encode/render asymmetry. Restricted to runs
+        # where the split eval step needs no collectives and the pyramid is
+        # a pure function of (src, disparity): otherwise fall back to the
+        # fused eval_step with a logged reason.
+        self.serve_cfg = serve_config_from_dict(self.config)
+        self.eval_encode_once = bool(self.serve_cfg.eval_encode_once)
+        if self.eval_encode_once:
+            reason = None
+            if jax.process_count() > 1:
+                reason = "multi-host run (eval steps are collective)"
+            elif trainer.mesh is not None and trainer.mesh.size > 1:
+                reason = "mesh size > 1 (eval steps are sharded)"
+            elif trainer.cfg.num_bins_fine > 0:
+                reason = ("mpi.num_bins_fine > 0 (coarse-to-fine importance-"
+                          "samples planes per step; pyramids aren't reusable)")
+            if reason is not None:
+                self.eval_encode_once = False
+                self._log("serve.eval_encode_once disabled: %s", reason)
 
     # ---------------- top-level ----------------
 
@@ -350,6 +375,12 @@ class TrainLoop:
             shard_index=jax.process_index(), num_shards=num_shards)
         eval_rng = jax.random.PRNGKey(0)
         gstep = int(state.step)
+        # Fresh pyramid cache per eval: entries are keyed by image id only,
+        # and the params this eval sees differ from the last one's.
+        eval_cache = PyramidCache(
+            capacity_bytes=self.serve_cfg.cache_bytes,
+            quant=self.serve_cfg.eval_cache_quant) \
+            if self.eval_encode_once else None
         full_seen = 0
         leftover = []  # host-local single-example dicts beyond common_full
         template = None  # any local example, for padding
@@ -362,9 +393,14 @@ class TrainLoop:
                                 for j in range(n))
                 continue
             full_seen += 1
-            batch = self.trainer.put_batch(np_batch)
-            metrics, visuals = self.trainer.eval_step(
-                state, batch, jax.random.fold_in(eval_rng, i))
+            if eval_cache is not None:
+                batch, metrics, visuals = self._eval_batch_encode_once(
+                    state, np_batch, jax.random.fold_in(eval_rng, i),
+                    eval_cache)
+            else:
+                batch = self.trainer.put_batch(np_batch)
+                metrics, visuals = self.trainer.eval_step(
+                    state, batch, jax.random.fold_in(eval_rng, i))
             m = metrics_to_float(metrics)
             for k, meter in self.val_meters.items():
                 meter.update(m[k], n=global_bs)
@@ -388,11 +424,17 @@ class TrainLoop:
             chunk = chunk + [template] * (lbs - len(chunk))
             local = {k: np.concatenate([c[k] for c in chunk], axis=0)
                      for k in chunk[0]}
-            batch = self.trainer.put_batch(local)
-            weight = self.trainer.put_example_array(w_local)
-            metrics = self.trainer.eval_step_masked(
-                state, batch, jax.random.fold_in(eval_rng, 1_000_000 + j),
-                weight)
+            if eval_cache is not None:
+                _, metrics, _ = self._eval_batch_encode_once(
+                    state, local,
+                    jax.random.fold_in(eval_rng, 1_000_000 + j),
+                    eval_cache, w_local=w_local)
+            else:
+                batch = self.trainer.put_batch(local)
+                weight = self.trainer.put_example_array(w_local)
+                metrics = self.trainer.eval_step_masked(
+                    state, batch,
+                    jax.random.fold_in(eval_rng, 1_000_000 + j), weight)
             m = metrics_to_float(metrics)
             # valid examples in THIS tail batch across all hosts
             # (deterministic from the shard counts)
@@ -404,9 +446,54 @@ class TrainLoop:
         self._log("Evaluation finished, average losses:")
         for m in self.val_meters.values():
             self._log("    %s" % m)
+        if eval_cache is not None:
+            s = eval_cache.stats()
+            self._log("Encode-once eval: %d encodes, %d replays (%s cache, "
+                      "%.1f MB)", s["misses"], s["hits"], s["quant"],
+                      s["nbytes"] / 1e6)
         for k, meter in self.val_meters.items():
             self._tb("add_scalar", k + "/val", meter.avg, gstep)
         return {k: meter.avg for k, meter in self.val_meters.items()}
+
+    def _eval_batch_encode_once(self, state: TrainState, np_batch, key,
+                                eval_cache, w_local=None):
+        """One eval batch with the encoder amortized across target views.
+
+        Derives the SAME per-batch disparity sample as the fused eval step
+        (fold_in(eval_rng, i) -> split -> sample_disparity), encodes only
+        source images whose pyramid isn't cached, and runs the batched
+        render+loss half on the replayed pyramids. A source seen again
+        reuses its first-seen disparity row — an RNG-level shift vs. the
+        fused path (identical when val sources are distinct; the metric-
+        parity test runs on a distinct-source set)."""
+        B = np_batch["src_img"].shape[0]
+        d_key, _ = jax.random.split(key)  # split mirrors _eval_step_impl
+        disparity = np.asarray(sample_disparity(d_key, B, self.trainer.cfg))
+        rows = []
+        for b in range(B):
+            img_b = np_batch["src_img"][b:b + 1]
+            iid = image_id_for(img_b)
+            cached = eval_cache.get(iid)
+            if cached is None:
+                mpi_b = self.trainer.eval_encode(
+                    state, jnp.asarray(img_b),
+                    jnp.asarray(disparity[b:b + 1]))
+                eval_cache.put(iid, [m[0] for m in mpi_b], disparity[b])
+                cached = eval_cache.get(iid)
+            rows.append(cached)
+        num_scales = len(rows[0][0])
+        mpi_list = [jnp.stack([r[0][s] for r in rows], axis=0)
+                    for s in range(num_scales)]
+        disparity_all = jnp.stack([r[1] for r in rows], axis=0)
+        batch = self.trainer.put_batch(np_batch)
+        if w_local is None:
+            metrics, visuals = self.trainer.eval_losses(
+                state, mpi_list, disparity_all, batch)
+            return batch, metrics, visuals
+        metrics = self.trainer.eval_losses_masked(
+            state, mpi_list, disparity_all, batch,
+            self.trainer.put_example_array(w_local))
+        return batch, metrics, None
 
     # ---------------- logging ----------------
 

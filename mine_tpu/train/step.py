@@ -186,6 +186,14 @@ class SynthesisTrainer:
                                    donate_argnums=donate_train)
             self._eval_step = jit(self._eval_step_impl)
             self._eval_step_masked = jit(self._eval_step_masked_impl)
+        # Encode-once eval (serve.eval_encode_once, train/loop.py run_eval):
+        # the eval step split into its two halves so the host loop can cache
+        # the encode per DISTINCT source image (serve.PyramidCache) and pay
+        # only the loss/render half per (src, tgt) pair. Gated to
+        # single-host / mesh-size-1 in the loop, so plain jit suffices.
+        self._eval_encode = jit(self._eval_encode_impl)
+        self._eval_losses = jit(self._eval_losses_impl)
+        self._eval_losses_masked = jit(self._eval_losses_masked_impl)
 
     # ---------------- batch geometry ----------------
 
@@ -356,6 +364,31 @@ class SynthesisTrainer:
                                           example_weight)
         return metrics
 
+    def _eval_encode_impl(self, state: TrainState, src_img, disparity):
+        """Encode half of the eval step: model forward only (eval-mode BN,
+        no coarse-to-fine — the encode-once path is gated to
+        mpi.num_bins_fine=0). Returns the 4-scale MPI pyramid."""
+        return self.model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            src_img, disparity, train=False)
+
+    def _eval_losses_impl(self, state: TrainState, mpi_list, disparity_all,
+                          batch, example_weight=None):
+        """Render+loss half of the eval step, fed a (possibly cache-replayed)
+        MPI pyramid instead of re-running the encoder."""
+        del state  # same call signature family as the other eval steps
+        _, metrics, visuals = compute_losses(
+            mpi_list, disparity_all, batch, self.cfg, mesh=self.mesh,
+            is_val=True, lpips_params=self.lpips_params,
+            example_weight=example_weight)
+        return metrics, visuals
+
+    def _eval_losses_masked_impl(self, state: TrainState, mpi_list,
+                                 disparity_all, batch, example_weight):
+        metrics, _ = self._eval_losses_impl(state, mpi_list, disparity_all,
+                                            batch, example_weight)
+        return metrics
+
     # ---------------- public API ----------------
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
@@ -371,6 +404,19 @@ class SynthesisTrainer:
         as weighted means over the real examples only (no dropped val
         examples on any host count — VERDICT r2 weak item 4)."""
         return self._eval_step_masked(state, batch, eval_key, example_weight)
+
+    def eval_encode(self, state: TrainState, src_img, disparity):
+        """[B,H,W,3] src + [B,S] disparity -> 4-scale MPI pyramid (list of
+        [B,S,4,h,w]); the cacheable half of the encode-once eval path."""
+        return self._eval_encode(state, src_img, disparity)
+
+    def eval_losses(self, state: TrainState, mpi_list, disparity_all, batch):
+        return self._eval_losses(state, mpi_list, disparity_all, batch)
+
+    def eval_losses_masked(self, state: TrainState, mpi_list, disparity_all,
+                           batch, example_weight):
+        return self._eval_losses_masked(state, mpi_list, disparity_all,
+                                        batch, example_weight)
 
     def put_example_array(self, v):
         """[local_B,...] host array -> global batch-sharded device array."""

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Render-only serving CLI: encode each image once, render trajectories from
+the shared quantized MPI cache (README "Serving").
+
+  python serve_cli.py --checkpoint_path ws/v1/checkpoint_latest \
+      --data_path photos/ --output_dir out/
+
+Where infer_cli.py is one-shot (one image -> its videos), this CLI is the
+serving engine's front door: ONE RenderEngine + MPICache (serve.* config
+keys) shared across every input image, so repeated or interleaved requests
+for the same image skip the encoder entirely. Prints the cache stats line
+and views/s at exit. Accepts a single image file or a directory of images;
+checkpoint handling (params.yaml next to the checkpoint, .npz or orbax)
+matches infer_cli.py.
+"""
+
+import argparse
+import json
+import os
+import time
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def _image_paths(data_path):
+    if os.path.isdir(data_path):
+        names = sorted(n for n in os.listdir(data_path)
+                       if n.lower().endswith(IMG_EXTS))
+        return [os.path.join(data_path, n) for n in names]
+    return [data_path]
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Render-only serving")
+    parser.add_argument("--checkpoint_path", type=str, required=True)
+    parser.add_argument("--data_path", type=str, required=True,
+                        help="image file or directory of images")
+    parser.add_argument("--output_dir", type=str, required=True)
+    parser.add_argument("--gpus", type=str, default=None,
+                        help="ignored (reference-CLI parity)")
+    parser.add_argument("--extra_config", type=str, default="{}",
+                        help='JSON config overrides, e.g. '
+                             '\'{"serve.cache_quant": "int8"}\'')
+    parser.add_argument("--warmup", action="store_true",
+                        help="pre-compile every pose bucket before timing")
+    args = parser.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from mine_tpu.utils import configure_compile_cache
+    configure_compile_cache()
+
+    import cv2
+    import numpy as np
+    import yaml
+
+    from mine_tpu.config import (CONFIG_DIR, load_config, postprocess,
+                                 serve_config_from_dict)
+    from mine_tpu.infer.video import (WARP_BAND, VideoGenerator,
+                                      generate_trajectories)
+    from mine_tpu.kernels import on_tpu_backend
+    from mine_tpu.serve import MPICache, RenderEngine
+    from mine_tpu.train.step import SynthesisTrainer
+    from mine_tpu.utils import make_logger
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger = make_logger(os.path.join(args.output_dir, "serve.log"))
+
+    ckpt_dir = os.path.dirname(os.path.abspath(args.checkpoint_path))
+    params_yaml = os.path.join(ckpt_dir, "params.yaml")
+    if os.path.exists(params_yaml):
+        with open(params_yaml) as f:
+            config = postprocess(yaml.safe_load(f))
+        config.update(json.loads(args.extra_config))
+    else:
+        logger.info("No params.yaml next to checkpoint; using LLFF defaults")
+        config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"),
+                             extra_config=args.extra_config)
+    serve_cfg = serve_config_from_dict(config)
+
+    trainer = SynthesisTrainer(config, steps_per_epoch=1)
+    state = trainer.init_state(batch_size=1)
+    params, batch_stats = state.params, state.batch_stats
+
+    if args.checkpoint_path.endswith(".npz"):
+        from mine_tpu.train.checkpoint import load_pretrained_params
+        params, batch_stats = load_pretrained_params(
+            args.checkpoint_path, params, batch_stats, logger)
+    else:
+        from mine_tpu.train.checkpoint import CheckpointManager
+        mgr = CheckpointManager(ckpt_dir or ".")
+        restored = mgr.restore(state, os.path.abspath(args.checkpoint_path))
+        if restored is None:
+            raise FileNotFoundError(args.checkpoint_path)
+        params, batch_stats = restored.params, restored.batch_stats
+        logger.info("Restored checkpoint at step %d", int(restored.step))
+
+    # ONE engine + cache for the whole run: every VideoGenerator below
+    # deposits its encode here, trajectories render through the same
+    # compile-once bucketed program (mine_tpu/serve/engine.py)
+    backend = "pallas" if on_tpu_backend() else "xla"
+    engine = RenderEngine(
+        use_alpha=bool(config.get("mpi.use_alpha", False)),
+        is_bg_depth_inf=bool(config.get("mpi.is_bg_depth_inf", False)),
+        backend=backend,
+        warp_band=WARP_BAND,
+        max_bucket=serve_cfg.max_bucket,
+        cache=MPICache(capacity_bytes=serve_cfg.cache_bytes,
+                       quant=serve_cfg.cache_quant))
+
+    paths = _image_paths(args.data_path)
+    if not paths:
+        raise FileNotFoundError(f"no images under {args.data_path}")
+    t0 = time.perf_counter()
+    views = 0
+    for path in paths:
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            logger.info("skipping unreadable %s", path)
+            continue
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        gen = VideoGenerator(config, params, batch_stats, img,
+                             chunk=serve_cfg.max_bucket, engine=engine)
+        if args.warmup and views == 0:
+            engine.warmup(gen.image_id)
+            t0 = time.perf_counter()  # don't bill compiles to throughput
+        name = os.path.basename(path).rsplit(".", 1)[0]
+        for w in gen.render_videos(args.output_dir, name):
+            logger.info("wrote %s", w)
+        views += sum(t.shape[0] for t in generate_trajectories(
+            config.get("data.name", "_default"))[0])
+    dt = time.perf_counter() - t0
+
+    stats = engine.cache.stats()
+    logger.info("serve stats: entries=%d nbytes=%d hits=%d misses=%d "
+                "evictions=%d quant=%s device_calls=%d",
+                stats["entries"], stats["nbytes"], stats["hits"],
+                stats["misses"], stats["evictions"], stats["quant"],
+                engine.device_calls)
+    logger.info("rendered %d views from %d images in %.2fs (%.2f views/s)",
+                views, len(paths), dt, views / max(dt, 1e-9))
+
+
+if __name__ == "__main__":
+    main()

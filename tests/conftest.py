@@ -64,6 +64,7 @@ QUICK = {
     "test_realestate10k.py::test_parse_camera_file",
     "test_rendering.py::test_alpha_composition_two_planes",
     "test_sampling.py::test_stratified_linspace_bins",
+    "test_serve.py::test_lru_eviction_order_under_byte_budget",
     "test_train.py::test_multistep_lr_schedule",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
@@ -98,6 +99,10 @@ MEDIUM_FILES = {
     # tentpole): what a reviewer most wants re-run after touching the loss
     "test_fused_loss.py",
     "test_packed_decoder.py",
+    # the serving engine's bitwise contracts (quant cache, bucketed render,
+    # video path): what a reviewer most wants re-run after touching warp or
+    # compositing (~30 s of the tier's budget)
+    "test_serve.py",
     # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
     # eval): the closest thing to a real-data rehearsal, gated here so it
     # can't rot (round-4 VERDICT item 8; ~5 min of the tier's budget)

@@ -105,6 +105,37 @@ def test_eval_step_masked_padding_invariant():
 
 
 @pytest.mark.slow
+def test_run_eval_encode_once_metric_parity(tmp_path):
+    """serve.eval_encode_once (encode each distinct src ONCE, replay the
+    cached pyramid for every pair) must reproduce the fused eval path's
+    metrics. Parity is np.allclose, not bitwise: the cached path encodes
+    each image at B=1 and batches losses afterward, so conv reductions
+    associate differently in the low-order bits."""
+    cfg = tiny_config()
+    cfg["data.per_gpu_batch_size"] = 2
+    data = SyntheticLoaderAdapter(num_views=6)  # batches 2,2 + masked tail
+    state = SynthesisTrainer(cfg, steps_per_epoch=5).init_state(batch_size=2)
+
+    def eval_metrics(encode_once):
+        c = dict(cfg)
+        c["serve.eval_encode_once"] = encode_once
+        loop = TrainLoop(SynthesisTrainer(c, steps_per_epoch=5), data, data,
+                         str(tmp_path / ("ws_eo" if encode_once else "ws")),
+                         logger=None, tb_writer=None)
+        assert loop.eval_encode_once == encode_once
+        results = loop.run_eval(state)
+        assert loop.val_meters["loss"].count == len(data) == 5
+        return results
+
+    fused = eval_metrics(False)
+    cached = eval_metrics(True)
+    assert fused.keys() == cached.keys()
+    for k in fused:
+        np.testing.assert_allclose(cached[k], fused[k], rtol=1e-4,
+                                   err_msg=k)
+
+
+@pytest.mark.slow
 def test_train_loop_runs_epochs_evals_and_resumes(tmp_path):
     cfg = tiny_config()
     cfg.update({

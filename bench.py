@@ -192,6 +192,15 @@ VARIANTS = {
     # encode-once serving engine as one monotone parseable stderr line;
     # JSON ips = the v=64 reading (its asymptote is renderpass throughput).
     "serve_amortize": (1, {}),
+    # SERVING SLO curve (not a train-step variant): OPEN-LOOP Poisson
+    # arrivals against the engine + micro-batcher — requests land at
+    # scheduled exponential-gap times whether or not the server keeps up,
+    # so queueing delay appears in the latency the instant offered load
+    # exceeds capacity (closed-loop rows like renderpass can never show
+    # that). One parseable stderr line of offered-QPS : p50 : p99 :
+    # achieved-QPS points; JSON ips = the knee-of-curve throughput (the
+    # highest offered rate the stack still served at >= 0.9x).
+    "serve_slo": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -656,6 +665,101 @@ def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
     return curve[-1][1], tflops, (run if keep_run else None), v_max
 
 
+# offered-rate sweep of the SLO row, as fractions of the measured
+# closed-loop base throughput: below / at / past the capacity knee
+SERVE_SLO_RATE_FRACS = (0.25, 0.5, 0.75, 1.0, 1.25)
+
+
+def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
+    """Open-loop Poisson SLO bench (the serve_slo variant).
+
+    Calibrates the stack's closed-loop base throughput, then replays a
+    fixed-seed Poisson arrival schedule through the micro-batcher at
+    offered rates spanning the knee. Per-request latency is completion
+    minus SCHEDULED arrival (not submit time): under overload the
+    generator never slows down, so queueing delay accumulates into p99
+    exactly as a real client would see it. Reported per rate: p50/p99
+    latency and achieved QPS (n / last-completion); the knee is the
+    highest offered rate still achieving >= 0.9x offered. Each point also
+    lands in the telemetry event stream ("serve.slo_point")."""
+    import numpy as np
+
+    from mine_tpu.serve.batcher import MicroBatcher
+
+    trainer, state, batch = build_variant_program(name)
+    max_bucket = 8
+    engine, image_id, _, _, _ = _serve_bench_engine(
+        trainer, state, batch, max_bucket=max_bucket)
+    engine.warmup(image_id)  # compiles never pollute a latency percentile
+
+    # closed-loop calibration: full-bucket renders -> views/s capacity
+    poses = _serve_bench_poses(max_bucket)
+    calls = 2 if SMOKE else 10
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        engine.render(image_id, poses)
+    base_qps = calls * max_bucket / (time.perf_counter() - t0)
+
+    n_req = 24 if SMOKE else 64
+    rng = np.random.RandomState(0)  # fixed schedule: reruns are comparable
+    curve = []  # (offered, p50_ms, p99_ms, achieved)
+    for frac in SERVE_SLO_RATE_FRACS:
+        offered = base_qps * frac
+        sched = np.cumsum(rng.exponential(1.0 / offered, size=n_req))
+        batcher = MicroBatcher(engine, max_requests=max_bucket,
+                               max_wait_ms=2.0)
+        done_at = [None] * n_req
+
+        def _cb(i):
+            def record(_fut, _i=i):
+                done_at[_i] = time.perf_counter()
+            return record
+
+        futs = []
+        t_start = time.perf_counter()
+        for i in range(n_req):
+            # open loop: sleep until the SCHEDULED arrival — never longer
+            # because the server is behind (that is the whole point)
+            lag = sched[i] - (time.perf_counter() - t_start)
+            if lag > 0:
+                time.sleep(lag)
+            fut = batcher.submit(image_id, poses[i % max_bucket])
+            fut.add_done_callback(_cb(i))
+            futs.append(fut)
+        for fut in futs:
+            fut.result()
+        batcher.close()
+        lat_ms = np.asarray(
+            [(done_at[i] - t_start - sched[i]) * 1e3 for i in range(n_req)])
+        achieved = n_req / (max(done_at) - t_start)
+        p50, p99 = np.percentile(lat_ms, [50, 99])
+        curve.append((offered, float(p50), float(p99), achieved))
+        from mine_tpu import telemetry
+        telemetry.emit("serve.slo_point", offered_qps=round(offered, 3),
+                       p50_ms=round(float(p50), 3),
+                       p99_ms=round(float(p99), 3),
+                       achieved_qps=round(achieved, 3), n_requests=n_req)
+
+    print("  serve_slo curve: "
+          + " ".join("%.2f:%.1f:%.1f:%.2f" % pt for pt in curve)
+          + "  (offered_qps:p50_ms:p99_ms:achieved_qps)", file=sys.stderr)
+    # highest offered rate the stack still kept up with; when even the
+    # lightest point missed (tiny smoke schedules drown in batcher linger),
+    # fall back to the best achieved rate — the capacity estimate
+    knee = max((pt[0] for pt in curve if pt[3] >= 0.9 * pt[0]),
+               default=max(pt[3] for pt in curve))
+    print("  serve_slo knee: %.2f qps (base closed-loop %.2f views/s)"
+          % (knee, base_qps), file=sys.stderr)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            engine.render(image_id, poses)
+        return time.perf_counter() - t0
+
+    return knee, None, (run if keep_run else None), 1
+
+
 def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
     """training.ssim_precision A/B (the ssim_precision_ab variants).
 
@@ -696,6 +800,8 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
         return _measure_renderpass(name, steps=steps, keep_run=keep_run)
     if name.startswith("serve_amortize"):
         return _measure_serve_amortize(name, steps=steps, keep_run=keep_run)
+    if name.startswith("serve_slo"):
+        return _measure_serve_slo(name, steps=steps, keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):

@@ -259,6 +259,49 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Observability knobs (mine_tpu/telemetry; README "Observability").
+
+    Entirely host-side — nothing here changes jitted numerics or adds a
+    per-step device sync (tests/test_telemetry.py pins that bitwise)."""
+    # telemetry.enabled: master switch for the metrics registry mirror and
+    # the JSONL event sink wiring in the train loop / serve CLI (the frozen
+    # step-time LOG line prints regardless — it predates this layer)
+    enabled: bool = True
+    # telemetry.events_path: JSONL event stream destination; "" defaults to
+    # <workspace>/events.jsonl (train loop) or <output_dir>/events.jsonl
+    # (serve_cli). The MINE_TPU_TELEMETRY_EVENTS env var outranks both.
+    events_path: str = ""
+    # telemetry.profile_steps: [start, stop] global-step range (inclusive)
+    # to capture under jax.profiler; empty/null disables
+    profile_steps: tuple = ()
+    # telemetry.profile_dir: trace destination; "" -> <workspace>/profile
+    profile_dir: str = ""
+
+
+def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
+    g = config.get
+    steps = g("telemetry.profile_steps") or ()
+    if isinstance(steps, (int, float, str)):
+        raise ValueError(
+            f"telemetry.profile_steps must be a [start, stop] list, "
+            f"got {steps!r}")
+    out = TelemetryConfig(
+        enabled=bool(g("telemetry.enabled", True)),
+        events_path=str(g("telemetry.events_path", "") or ""),
+        profile_steps=tuple(int(s) for s in steps),
+        profile_dir=str(g("telemetry.profile_dir", "") or ""),
+    )
+    if out.profile_steps and (
+            len(out.profile_steps) != 2 or out.profile_steps[0] < 1
+            or out.profile_steps[1] < out.profile_steps[0]):
+        raise ValueError(
+            "telemetry.profile_steps must be [start, stop] with "
+            f"1 <= start <= stop, got {list(out.profile_steps)}")
+    return out
+
+
 # Datasets for which the sparse-3D-point disparity loss and scale factor are
 # disabled (reference: synthesis_task.py:213-214,297).
 _NO_DISP_DATASETS = ("flowers", "kitti_raw", "dtu")

@@ -27,6 +27,7 @@ from typing import Callable, Dict, Iterator, List, Tuple
 
 import numpy as np
 
+from mine_tpu import telemetry
 from mine_tpu.testing import faults
 
 
@@ -73,10 +74,15 @@ class _PipelineStats:
     def record_error(self, n: int = 1):
         with self._lock:
             self.data_errors += n
+        telemetry.counter("data.errors").inc(n)
 
     def record_quarantine(self, index: int):
         with self._lock:
+            new = int(index) not in self.quarantined
             self.quarantined.add(int(index))
+        if new:
+            telemetry.counter("data.quarantined").inc()
+            telemetry.emit("data.quarantine", index=int(index))
 
     def is_quarantined(self, index: int) -> bool:
         with self._lock:
@@ -85,6 +91,7 @@ class _PipelineStats:
     def record_respawn(self):
         with self._lock:
             self.worker_respawns += 1
+        telemetry.counter("data.worker_respawns").inc()
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:

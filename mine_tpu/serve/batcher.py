@@ -15,11 +15,13 @@ drive `flush()` directly with `start=False` (no timing dependence).
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from mine_tpu import telemetry
 from mine_tpu.serve.engine import RenderEngine
 
 
@@ -35,7 +37,9 @@ class MicroBatcher:
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.flushes = 0
         self._cv = threading.Condition()
-        self._pending: List[Tuple[str, np.ndarray, Future]] = []
+        # (image_id, pose, future, enqueue perf_counter) — the timestamp
+        # feeds the serve.batcher.queue_wait_ms histogram at flush
+        self._pending: List[Tuple[str, np.ndarray, Future, float]] = []
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -51,7 +55,8 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append(
-                (image_id, np.asarray(pose_44, np.float32), fut))
+                (image_id, np.asarray(pose_44, np.float32), fut,
+                 time.perf_counter()))
             self._cv.notify()
         return fut
 
@@ -63,14 +68,21 @@ class MicroBatcher:
             del self._pending[:len(batch)]
         if not batch:
             return 0
+        now = time.perf_counter()
+        wait_hist = telemetry.histogram("serve.batcher.queue_wait_ms")
+        for _, _, _, t_enq in batch:
+            wait_hist.record((now - t_enq) * 1e3)
+        telemetry.histogram(
+            "serve.batcher.coalesce_size",
+            edges=telemetry.pow2_buckets(1024)).record(len(batch))
         try:
             results = self.engine.render_many(
-                [(i, p) for i, p, _ in batch])
+                [(i, p) for i, p, _, _ in batch])
             self.flushes += 1
-            for (_, _, fut), res in zip(batch, results):
+            for (_, _, fut, _), res in zip(batch, results):
                 fut.set_result(res)
         except Exception as e:  # pragma: no cover - device failures
-            for _, _, fut in batch:
+            for _, _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
         return len(batch)

@@ -32,6 +32,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from mine_tpu import telemetry
+
 QUANT_MODES = ("float32", "bf16", "int8")
 
 
@@ -91,14 +93,24 @@ def _entry_nbytes(entry_arrays) -> int:
                    for a in entry_arrays if a is not None))
 
 
+def _sync_cache_gauges(cache) -> None:
+    """Mirror a cache's residency into the registry (both cache classes)."""
+    telemetry.gauge(cache._METRIC_PREFIX + ".entries").set(len(cache._entries))
+    telemetry.gauge(cache._METRIC_PREFIX + ".nbytes").set(cache.nbytes)
+
+
 class MPICache:
     """LRU over MPIEntry under `capacity_bytes` (0 = unbounded).
 
     get() refreshes recency; put() evicts least-recently-used entries until
     the new total fits (a single entry larger than the budget still stores —
     it just evicts everything else first). hits/misses/evictions counters
-    feed serve_cli's stats line and the amortization bench.
+    feed serve_cli's stats line and the amortization bench; the same
+    counts mirror into the telemetry registry under `serve.cache.*`
+    (instance attrs are per-cache, registry counters are process-wide).
     """
+
+    _METRIC_PREFIX = "serve.cache"
 
     def __init__(self, capacity_bytes: int = 0, quant: str = "bf16"):
         if quant not in QUANT_MODES:
@@ -146,14 +158,18 @@ class MPICache:
                 _, evicted = self._entries.popitem(last=False)
                 self.nbytes -= evicted.nbytes
                 self.evictions += 1
+                telemetry.counter(self._METRIC_PREFIX + ".evictions").inc()
+        _sync_cache_gauges(self)
         return entry
 
     def get(self, image_id: str) -> Optional[MPIEntry]:
         entry = self._entries.get(image_id)
         if entry is None:
             self.misses += 1
+            telemetry.counter(self._METRIC_PREFIX + ".misses").inc()
             return None
         self.hits += 1
+        telemetry.counter(self._METRIC_PREFIX + ".hits").inc()
         self._entries.move_to_end(image_id)
         return entry
 
@@ -172,8 +188,11 @@ class PyramidCache:
     each distinct source image once and replays the pyramid for every
     (src, tgt) pair; the loss consumes all scales, so the whole pyramid is
     the cache unit (one entry evicts atomically — no partial pyramids).
-    Same LRU/byte-budget/quantization semantics as MPICache.
+    Same LRU/byte-budget/quantization semantics as MPICache; registry
+    metrics land under `serve.eval_cache.*`.
     """
+
+    _METRIC_PREFIX = "serve.eval_cache"
 
     def __init__(self, capacity_bytes: int = 0, quant: str = "float32"):
         if quant not in QUANT_MODES:
@@ -208,14 +227,18 @@ class PyramidCache:
                 _, evicted = self._entries.popitem(last=False)
                 self.nbytes -= evicted[2]
                 self.evictions += 1
+                telemetry.counter(self._METRIC_PREFIX + ".evictions").inc()
+        _sync_cache_gauges(self)
 
     def get(self, image_id: str):
         """-> (per-scale dequantized f32 volumes, disparity [S]) or None."""
         entry = self._entries.get(image_id)
         if entry is None:
             self.misses += 1
+            telemetry.counter(self._METRIC_PREFIX + ".misses").inc()
             return None
         self.hits += 1
+        telemetry.counter(self._METRIC_PREFIX + ".hits").inc()
         self._entries.move_to_end(image_id)
         stored, disparity, _ = entry
         return [dequantize_planes(q, s) for q, s in stored], disparity

@@ -26,6 +26,7 @@ planes.
 
 from __future__ import annotations
 
+import time
 import warnings
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -34,7 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from mine_tpu import geometry
+from mine_tpu import geometry, telemetry
 from mine_tpu.ops import rendering
 from mine_tpu.serve.cache import MPICache, MPIEntry, image_id_for
 
@@ -44,7 +45,9 @@ _warned_sync_encode = set()
 def _warn_sync_encode(engine_key, image_id: str) -> None:
     """One-time notice that a serve request missed the cache and forced a
     synchronous encode — the slow path must be visible in logs (same
-    pattern as ops/rendering._warn_backend_fallback)."""
+    pattern as ops/rendering._warn_backend_fallback). The `serve.sync_encode`
+    counter records EVERY occurrence (the warning only fires once per
+    engine, which made sustained slow-path traffic invisible)."""
     if engine_key not in _warned_sync_encode:
         _warned_sync_encode.add(engine_key)
         warnings.warn(
@@ -107,6 +110,11 @@ class RenderEngine:
         # misses; None keeps the engine strictly render-only (miss raises)
         self.encode_fn = encode_fn
         self.device_calls = 0
+        self.sync_encodes = 0
+        # (Rb, Pb, warp_impl, planes dtype) keys already dispatched: a
+        # first-seen key means jit traces + compiles a new executable —
+        # the compile-set growth the pow2 bucketing is meant to bound
+        self._seen_buckets = set()
         self._render = jax.jit(self._render_impl,
                                static_argnames=("warp_impl",))
 
@@ -138,7 +146,14 @@ class RenderEngine:
                 f"image {image_id[:12]}… not cached and no synchronous "
                 f"encode path (pass image= and set encode_fn)")
         _warn_sync_encode(id(self), image_id)
-        return self.cache.put(image_id, *self.encode_fn(image))
+        self.sync_encodes += 1
+        telemetry.counter("serve.sync_encode").inc()
+        # emit=False: the span event would duplicate this richer one
+        with telemetry.span("serve.sync_encode", emit=False):
+            entry = self.cache.put(image_id, *self.encode_fn(image))
+        telemetry.emit("serve.sync_encode", image_id=image_id[:12],
+                       total=self.sync_encodes)
+        return entry
 
     # ---------------- jitted render ----------------
 
@@ -170,6 +185,7 @@ class RenderEngine:
     def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
               poses: np.ndarray, warp_impl: Optional[str]):
         """Bucket R and P, pad, dispatch ONE device call, slice."""
+        t0 = time.perf_counter()
         warp_impl = warp_impl or self.warp_impl
         P = poses.shape[0]
         Pb = pow2_bucket(P)
@@ -197,7 +213,23 @@ class RenderEngine:
                                   jnp.asarray(idx, jnp.int32),
                                   jnp.asarray(poses), warp_impl)
         self.device_calls += 1
-        return np.asarray(rgb[:P]), np.asarray(depth[:P])
+        out = np.asarray(rgb[:P]), np.asarray(depth[:P])  # device sync
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        bucket = (Rb, Pb, warp_impl, str(planes.dtype))
+        if bucket not in self._seen_buckets:
+            # first dispatch of this (shape-bucket, impl, dtype) key: jit
+            # traced + compiled a new executable, so this call's time is
+            # compile-dominated — recorded as a compile event, NOT into
+            # the warm-latency histogram it would wreck
+            self._seen_buckets.add(bucket)
+            telemetry.counter("serve.bucket_compiles").inc()
+            telemetry.emit("serve.bucket_compile", entries_bucket=Rb,
+                           poses_bucket=Pb, warp_impl=warp_impl,
+                           dtype=str(planes.dtype),
+                           compile_ms=round(elapsed_ms, 3))
+        else:
+            telemetry.histogram("serve.render_call_ms").record(elapsed_ms)
+        return out
 
     # ---------------- public render paths ----------------
 

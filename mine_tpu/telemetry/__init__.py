@@ -1,0 +1,46 @@
+"""Unified telemetry layer: metrics registry + event stream + span timers.
+
+Every subsystem that used to keep private observability state (the train
+loop's hand-formatted step line, serve's cache-attribute stats, the
+pipeline's error counters, one-time warnings standing in for counters) now
+also reports through this package, so train, serve and chaos paths emit one
+coherent, parseable surface:
+
+  registry.py  process-wide counters / gauges / fixed-bucket histograms
+               with p50/p90/p99 extraction (README "Observability" has the
+               metric catalog)
+  events.py    append-only schema-versioned JSONL event sink — non-fatal on
+               write failure, validated in CI (tools/validate_events.py),
+               consumed by tools/obs_report.py
+  spans.py     scoped wall-clock timers feeding both of the above
+  stepline.py  the frozen "time: schema=st1 ..." step-time line + its one
+               shared parser
+  profiler.py  opt-in jax.profiler trace windows over exact train-loop step
+               ranges (telemetry.profile_steps = [start, stop])
+
+Dependency-free (stdlib only) and strictly host-side: nothing in here is
+ever traced, so instrumentation cannot change jitted numerics or add a
+device sync — the bitwise-parity tests in tests/test_telemetry.py hold the
+package to that.
+"""
+
+from mine_tpu.telemetry.events import (emit, ensure_configured,
+                                       validate_file, validate_line)
+from mine_tpu.telemetry.profiler import ProfileWindow
+from mine_tpu.telemetry.registry import (REGISTRY, Counter, Gauge, Histogram,
+                                         MetricsRegistry, counter,
+                                         default_latency_buckets_ms, gauge,
+                                         histogram, pow2_buckets)
+from mine_tpu.telemetry.spans import current_span_path, span
+from mine_tpu.telemetry.stepline import (STEP_KEYS, STEP_SCHEMA, TIME_KEYS,
+                                         format_step_line, parse_line,
+                                         parse_lines)
+
+__all__ = [
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ProfileWindow",
+    "STEP_KEYS", "STEP_SCHEMA", "TIME_KEYS", "counter", "current_span_path",
+    "default_latency_buckets_ms", "emit", "ensure_configured",
+    "format_step_line", "gauge", "histogram", "parse_line", "parse_lines",
+    "pow2_buckets", "span", "validate_file", "validate_line",
+]

@@ -1,0 +1,231 @@
+"""Structured JSONL event sink: append-only, schema-versioned, non-fatal.
+
+Low-frequency, high-value happenings (a checkpoint save, a bucket compile, a
+guard abort, a profiler window, a per-log-interval step-time record) go here
+as one JSON object per line, so train, serve and chaos paths all emit ONE
+parseable stream that tools/obs_report.py consumes and
+tools/validate_events.py checks in CI. High-frequency numbers (per-request
+latencies, cache hits) belong in the metrics registry instead — the sink is
+not a firehose.
+
+Schema v1: every line is an object with
+    schema  literal "mtpu-ev1" (version tag; bump on breaking change)
+    ts      float unix seconds (host clock; ordering hint, not a vector)
+    kind    dotted event type, e.g. "ckpt.save", "serve.bucket_compile"
+plus kind-specific payload fields (JSON scalars/arrays/objects only).
+
+Failure policy is the PR-4 tensorboard precedent verbatim: an unwritable
+path, full disk, or dead filesystem degrades the sink to a no-op with ONE
+warning — observability must never kill a multi-hour run. Writes are single
+`write()` calls of complete lines on an O_APPEND stream, so concurrent
+emitters (threads, or chaos-test subprocesses sharing a path via the
+MINE_TPU_TELEMETRY_EVENTS env var) interleave at line granularity.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+SCHEMA = "mtpu-ev1"
+REQUIRED_FIELDS = ("schema", "ts", "kind")
+
+# Env override: when set, the first emit() in a process with no configured
+# sink appends there. This is how the tier-1 wrapper funnels every test's
+# events into one file for the schema-validation pass (tools/verify_tier1.sh)
+# and how chaos-test subprocesses inherit their parent's stream.
+ENV_VAR = "MINE_TPU_TELEMETRY_EVENTS"
+
+_log = logging.getLogger(__name__)
+
+
+class EventSink:
+    """One append-only JSONL stream. Opens lazily on first emit; any IO
+    failure (open or write) warns once and disables the sink."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = None
+        self._broken = False
+        self.emitted = 0
+        self.dropped = 0
+
+    def emit(self, kind: str, **fields) -> bool:
+        """Append one event; returns False when the sink is broken (the
+        caller never needs to check — this is for tests)."""
+        event = {"schema": SCHEMA, "ts": time.time(), "kind": str(kind)}
+        event.update(fields)
+        line = json.dumps(event, sort_keys=False, default=_jsonify)
+        with self._lock:
+            if self._broken:
+                self.dropped += 1
+                return False
+            try:
+                if self._file is None:
+                    parent = os.path.dirname(self.path)
+                    if parent:
+                        os.makedirs(parent, exist_ok=True)
+                    self._file = open(self.path, "a", buffering=1)
+                self._file.write(line + "\n")
+                self.emitted += 1
+                return True
+            except Exception:
+                self._broken = True
+                self.dropped += 1
+                _log.warning(
+                    "telemetry event sink failed (%s) — events disabled for "
+                    "the rest of the run", self.path, exc_info=True)
+                return False
+
+    @property
+    def broken(self) -> bool:
+        with self._lock:
+            return self._broken
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except Exception:
+                    pass
+                self._file = None
+
+
+def _jsonify(v):
+    """Last-resort encoder: numpy scalars/arrays from call sites that forgot
+    to convert — degrade to python types instead of killing the emit."""
+    if hasattr(v, "item") and getattr(v, "shape", None) == ():
+        return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return str(v)
+
+
+_state_lock = threading.Lock()
+_sink: Optional[EventSink] = None
+_env_checked = False
+
+
+def configure(path: Optional[str]) -> Optional[EventSink]:
+    """Point the process-wide sink at `path` (None disables). Replaces any
+    existing sink (closed first). Returns the new sink."""
+    global _sink, _env_checked
+    with _state_lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = EventSink(path) if path else None
+        _env_checked = True  # an explicit choice outranks the env default
+        return _sink
+
+
+def ensure_configured(default_path: Optional[str] = None
+                      ) -> Optional[EventSink]:
+    """Configure only if nothing is configured yet: the env var wins, then
+    `default_path`. This is the train-loop/serve_cli entry point — an outer
+    harness (tier-1, chaos soak) that exported MINE_TPU_TELEMETRY_EVENTS
+    keeps owning the stream."""
+    global _sink, _env_checked
+    with _state_lock:
+        if _sink is not None:
+            return _sink
+        env = os.environ.get(ENV_VAR)
+        path = env or default_path
+        _env_checked = True
+        if path:
+            _sink = EventSink(path)
+        return _sink
+
+
+def current_sink() -> Optional[EventSink]:
+    with _state_lock:
+        return _sink
+
+
+def emit(kind: str, **fields) -> bool:
+    """Append one event to the process sink. Unconfigured (and no env
+    default): a cheap no-op returning False, so instrumented libraries cost
+    nothing when nobody asked for events."""
+    global _sink, _env_checked
+    sink = _sink
+    if sink is None:
+        if _env_checked:
+            return False
+        with _state_lock:
+            if not _env_checked:
+                env = os.environ.get(ENV_VAR)
+                if env:
+                    _sink = EventSink(env)
+                _env_checked = True
+            sink = _sink
+        if sink is None:
+            return False
+    return sink.emit(kind, **fields)
+
+
+def reset() -> None:
+    """Tests only: drop the sink and re-arm the env-var check."""
+    global _sink, _env_checked
+    with _state_lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = None
+        _env_checked = False
+
+
+# ---------------------------------------------------------------- validation
+
+def validate_line(line: str) -> Optional[str]:
+    """Schema check of one JSONL line; None when valid, else a short error
+    string. Blank lines are valid (a crashed writer's trailing newline must
+    not fail CI). Shared by tools/validate_events.py and obs_report."""
+    s = line.strip()
+    if not s:
+        return None
+    try:
+        obj = json.loads(s)
+    except ValueError as e:
+        return f"not JSON: {e}"
+    if not isinstance(obj, dict):
+        return "not a JSON object"
+    for k in REQUIRED_FIELDS:
+        if k not in obj:
+            return f"missing required field {k!r}"
+    if obj["schema"] != SCHEMA:
+        return f"unknown schema {obj['schema']!r} (expected {SCHEMA!r})"
+    if not isinstance(obj["ts"], (int, float)):
+        return f"ts must be numeric, got {type(obj['ts']).__name__}"
+    if not isinstance(obj["kind"], str) or not obj["kind"]:
+        return "kind must be a non-empty string"
+    return None
+
+
+def validate_file(path: str, max_errors: int = 20) -> List[str]:
+    """-> list of "line N: error" strings (empty = file is schema-clean)."""
+    errors = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            err = validate_line(line)
+            if err is not None:
+                errors.append(f"line {i}: {err}")
+                if len(errors) >= max_errors:
+                    errors.append("... (truncated)")
+                    break
+    return errors
+
+
+def read_events(path: str) -> List[Dict]:
+    """Parse a JSONL event file, skipping invalid lines (the validator is
+    the strict path; readers are lenient so a torn tail line from a killed
+    run doesn't hide the rest of the stream)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if validate_line(line) is None and line.strip():
+                out.append(json.loads(line))
+    return out

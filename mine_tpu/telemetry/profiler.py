@@ -1,0 +1,105 @@
+"""Opt-in jax.profiler trace windows over exact train-loop step ranges.
+
+`telemetry.profile_steps: [start, stop]` brackets global steps start..stop
+INCLUSIVE: the trace starts before step `start` runs and stops after step
+`stop` completes, so the captured window is exactly the requested steps —
+no warmup compiles, no eval/checkpoint pauses unless they fall inside the
+range. The trace directory lands in the event stream ("profile.window"), so
+obs_report can point at it next to the step-time record of the same steps.
+
+Failure policy matches the rest of the telemetry layer: a profiler that
+cannot start (unwritable dir, unsupported backend) warns once and the
+window degrades to a no-op — profiling must never kill the run it profiles.
+
+bench.py's MINE_TPU_BENCH_PROFILE env knob keeps its own whole-variant
+trace; this module is the finer train-loop instrument the ROADMAP's chip
+windows want (bracket the 3 steps after a cadence boundary, not the sweep).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from mine_tpu.telemetry import events as _events
+
+_log = logging.getLogger(__name__)
+
+
+class ProfileWindow:
+    """Drive jax.profiler.start_trace/stop_trace from step-counter edges.
+
+    Call `maybe_start(next_step)` immediately before dispatching a step and
+    `maybe_stop(completed_step)` after it; both are cheap int compares when
+    the window is disabled, done, or out of range. A resume that lands past
+    `start` (mid-window restore) skips the window entirely rather than
+    capturing a partial, misleading range.
+    """
+
+    def __init__(self, steps: Sequence[int], trace_dir: str,
+                 logger: Optional[logging.Logger] = None):
+        steps = tuple(int(s) for s in (steps or ()))
+        if steps and (len(steps) != 2 or steps[0] < 1
+                      or steps[1] < steps[0]):
+            raise ValueError(
+                "telemetry.profile_steps must be [start, stop] with "
+                f"1 <= start <= stop, got {list(steps)}")
+        self.start_step = steps[0] if steps else 0
+        self.stop_step = steps[1] if steps else 0
+        self.trace_dir = trace_dir
+        self.active = False
+        self.done = not steps
+        self._logger = logger or _log
+
+    @property
+    def enabled(self) -> bool:
+        return not self.done or self.active
+
+    def maybe_start(self, next_step: int) -> None:
+        if self.done or self.active:
+            return
+        if next_step > self.start_step:
+            # resumed past the window: a partial trace would misreport the
+            # steps it claims to cover — skip, say so, move on
+            self.done = True
+            self._logger.warning(
+                "telemetry.profile_steps [%d, %d] skipped: run resumed at "
+                "step %d, past the window start",
+                self.start_step, self.stop_step, next_step)
+            return
+        if next_step == self.start_step:
+            try:
+                import jax
+                jax.profiler.start_trace(self.trace_dir)
+                self.active = True
+                self._logger.info(
+                    "profiler trace started at step %d (stops after %d): %s",
+                    self.start_step, self.stop_step, self.trace_dir)
+            except Exception:
+                self.done = True
+                self._logger.warning(
+                    "jax.profiler.start_trace(%s) failed — profile window "
+                    "disabled", self.trace_dir, exc_info=True)
+
+    def maybe_stop(self, completed_step: int) -> None:
+        if not self.active or completed_step < self.stop_step:
+            return
+        self.stop()
+
+    def stop(self) -> None:
+        """Stop an active trace (also the end-of-run safety net for a
+        window whose stop step was never reached)."""
+        if not self.active:
+            return
+        self.active = False
+        self.done = True
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            self._logger.info("profiler trace written: %s", self.trace_dir)
+            _events.emit("profile.window", trace_dir=self.trace_dir,
+                         start_step=self.start_step,
+                         stop_step=self.stop_step)
+        except Exception:
+            self._logger.warning("jax.profiler.stop_trace failed",
+                                 exc_info=True)

@@ -1,0 +1,288 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket histograms.
+
+The repo's observability was ad-hoc per-subsystem state (MPICache's `hits`
+attribute, PIPELINE_STATS' lock-guarded ints, the train loop's AverageMeter
+dict). This module is the one place those numbers now live: a dependency-free
+registry any layer can reach without plumbing handles through constructors —
+`telemetry.counter("serve.cache.hits").inc()` from the cache is visible to
+serve_cli's stats line, obs_report, and the SLO bench alike.
+
+Design constraints (the same ones the PR-4 guard obeyed):
+  * HOST-SIDE ONLY. Nothing here is traced; recording a metric never touches
+    a jax array, so instrumentation cannot add a device sync or perturb a
+    jitted program. Callers convert to python floats BEFORE recording.
+  * Thread-safe: serve's batcher flush thread, the pipeline's assembler
+    workers and the train loop all record concurrently.
+  * Fixed-bucket histograms, not reservoirs: O(buckets) memory forever,
+    mergeable, and quantiles are bounded by bucket width (documented below)
+    — the standard latency-histogram trade (Prometheus/HdrHistogram shape).
+
+Naming convention: dotted lowercase paths, unit-suffixed where a unit exists
+(`train.step_ms`, `serve.cache.bytes`). The README "Observability" section
+holds the catalog.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def default_latency_buckets_ms() -> Tuple[float, ...]:
+    """Geometric bucket edges covering 0.05 ms .. ~2 min with ~1.3x growth:
+    relative quantile error is bounded by the growth factor (a reported p99
+    lies within the true p99's bucket, i.e. within +-30%) at 56 buckets of
+    constant memory. Wide enough for a jit compile (tens of s), fine enough
+    for a sub-ms cache-hit render."""
+    edges, e = [], 0.05
+    while e < 120_000.0:
+        edges.append(e)
+        e *= 1.3
+    return tuple(edges)
+
+
+def pow2_buckets(max_edge: int = 4096) -> Tuple[float, ...]:
+    """1, 2, 4, ... edges for size-ish histograms (coalesce sizes, pose
+    counts): exact counts per power-of-two bucket, matching the serving
+    engine's pow2 shape-bucketing so the histogram reads as 'how often did
+    each compiled bucket run'."""
+    edges, e = [], 1
+    while e <= max_edge:
+        edges.append(float(e))
+        e *= 2
+    return tuple(edges)
+
+
+class Counter:
+    """Monotonic counter. `inc` only; resets only with its registry."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc by {n} < 0")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (cache residency bytes, cumulative counters
+    owned elsewhere and mirrored here at log cadence)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with p50/p90/p99 extraction.
+
+    `edges` are bucket UPPER bounds (ascending); a sample lands in the first
+    bucket whose edge is >= the sample, with one implicit overflow bucket
+    past the last edge. Quantiles linearly interpolate within the containing
+    bucket, so the reported value is within that bucket's span of the exact
+    order statistic — the error contract default_latency_buckets_ms
+    documents, pinned against numpy in tests/test_telemetry.py.
+    """
+
+    __slots__ = ("name", "edges", "_lock", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, name: str, edges: Optional[Sequence[float]] = None):
+        self.name = name
+        self.edges = tuple(float(e) for e in
+                           (edges if edges is not None
+                            else default_latency_buckets_ms()))
+        if list(self.edges) != sorted(self.edges) or len(self.edges) < 1:
+            raise ValueError(f"histogram {name}: edges must ascend, "
+                             f"got {self.edges[:4]}...")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.edges) + 1)  # +1 overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        if math.isnan(v):
+            return  # a NaN sample would poison sum/quantiles silently
+        # binary search for the first edge >= v
+        lo, hi = 0, len(self.edges)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.edges[mid] < v:
+                lo = mid + 1
+            else:
+                hi = mid
+        with self._lock:
+            self._counts[lo] += 1
+            self._count += 1
+            self._sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else float("nan")
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile (0 <= q <= 1); NaN on an empty histogram.
+        Clamped to the observed [min, max] so a sparse tail bucket can't
+        report a value beyond anything actually recorded."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0:
+                return float("nan")
+            target = q * self._count
+            cum = 0.0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lo = self.edges[i - 1] if i > 0 else 0.0
+                    hi = self.edges[i] if i < len(self.edges) else self._max
+                    frac = (target - cum) / c
+                    v = lo + (hi - lo) * frac
+                    return min(max(v, self._min), self._max)
+                cum += c
+            return self._max
+
+    def percentiles(self) -> Dict[str, float]:
+        return {"p50": self.quantile(0.50),
+                "p90": self.quantile(0.90),
+                "p99": self.quantile(0.99)}
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0}
+            out = {"count": self._count, "sum": self._sum,
+                   "mean": self._sum / self._count,
+                   "min": self._min, "max": self._max}
+        out.update(self.percentiles())
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics.
+
+    Asking for an existing name with a different type (or a histogram with
+    different edges) raises — two subsystems silently sharing a name under
+    different semantics is the bug registries exist to prevent.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str,
+                  edges: Optional[Sequence[float]] = None) -> Histogram:
+        h = self._get_or_create(name, Histogram, edges)
+        if edges is not None and h.edges != tuple(float(e) for e in edges):
+            raise ValueError(
+                f"histogram {name!r} already registered with different "
+                f"bucket edges")
+        return h
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, object]:
+        """Point-in-time dict of every metric under `prefix`: counters ->
+        int, gauges -> float, histograms -> their stat dict. JSON-safe by
+        construction — this is what the `metrics.snapshot` event carries."""
+        with self._lock:
+            items = [(n, m) for n, m in sorted(self._metrics.items())
+                     if n.startswith(prefix)]
+        out: Dict[str, object] = {}
+        for n, m in items:
+            if isinstance(m, Counter):
+                out[n] = m.value
+            elif isinstance(m, Gauge):
+                out[n] = m.value
+            else:
+                out[n] = m.snapshot()
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a long-lived process never calls it)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# THE process-wide registry. Module functions below are the idiomatic call
+# sites (`telemetry.counter(...)` via the package re-exports); passing an
+# explicit registry is for tests that need isolation.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str,
+              edges: Optional[Sequence[float]] = None) -> Histogram:
+    return REGISTRY.histogram(name, edges)

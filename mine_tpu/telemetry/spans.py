@@ -1,0 +1,84 @@
+"""Scoped wall-clock span timers over the metrics registry + event sink.
+
+    with telemetry.span("ckpt.save_latest", step=1234):
+        ...
+
+records the block's wall-clock into the histogram named after the span's
+DOTTED PATH — nested spans compose their names, so a span "restore" opened
+inside "ckpt" shows up as "ckpt.restore" — and (when a sink is configured)
+emits one {"kind": "span", "name": ..., "ms": ...} event carrying any extra
+fields. Exceptions propagate untouched; the duration still records with
+ok=false so a failing save's cost is visible, not lost.
+
+Nesting is thread-local: concurrent threads (batcher flush vs train loop)
+each have their own stack, so paths never interleave across threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from mine_tpu.telemetry import events as _events
+from mine_tpu.telemetry import registry as _registry
+
+_tls = threading.local()
+
+
+def _stack():
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_span_path() -> Optional[str]:
+    """Dotted path of the innermost open span on this thread, or None."""
+    s = _stack()
+    return ".".join(s) if s else None
+
+
+class span:
+    """Context manager; see module docstring. `emit=False` keeps a
+    high-frequency span out of the event stream (histogram only)."""
+
+    def __init__(self, name: str, emit: bool = True,
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 **fields):
+        if not name:
+            raise ValueError("span needs a non-empty name")
+        self.name = str(name)
+        self.emit_event = emit
+        self.registry = registry if registry is not None \
+            else _registry.REGISTRY
+        self.fields = fields
+        self.path: Optional[str] = None
+        self.ms: Optional[float] = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "span":
+        stack = _stack()
+        stack.append(self.name)
+        self.path = ".".join(stack)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.ms = (time.perf_counter() - self._t0) * 1e3
+        stack = _stack()
+        # unwind to OUR frame even if an inner span leaked (an inner
+        # __exit__ that never ran because its thread died): the stack must
+        # not corrupt every later span on this thread
+        while stack and stack[-1] != self.name:
+            stack.pop()
+        if stack:
+            stack.pop()
+        try:
+            self.registry.histogram(self.path + "_ms").record(self.ms)
+            if self.emit_event:
+                _events.emit("span", name=self.path, ms=round(self.ms, 3),
+                             ok=exc_type is None, **self.fields)
+        except Exception:
+            pass  # telemetry never turns a timed block's success into a fail
+        return False  # propagate exceptions

@@ -1,0 +1,99 @@
+"""The train loop's parseable step-time line: ONE frozen schema, ONE parser.
+
+PR 1 shipped the breakdown as a hand-formatted log fragment
+("time: step = 812.0 ms host_wait = 590.1 ms ...") and
+tools/step_breakdown.py grew its own regex; PR 4 appended data_errors.
+Anything scraping logs was then coupled to printf details three files away.
+This module freezes the contract:
+
+  schema "st1" (emitted by train/loop.py since the telemetry PR):
+
+    time: schema=st1 step_ms=812.0 host_wait_ms=590.1 device_ms=221.9 \
+h2d_ms=35.2 data_errors=0
+
+  * key=value pairs, space-separated, in exactly STEP_KEYS order
+  * the literal "schema=st1" tag directly after the "time:" marker
+  * times are milliseconds with one decimal; data_errors is an int
+  * new keys may only be APPENDED (parsers must ignore unknown tails);
+    any other change bumps the schema tag
+
+parse_line/parse_lines also accept the LEGACY pre-st1 form, so logs from
+older runs keep summarizing (pinned by tests/test_step_breakdown.py).
+Consumers: tools/step_breakdown.py, tools/obs_report.py — both import THIS
+parser; neither carries a private regex anymore.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional
+
+STEP_SCHEMA = "st1"
+
+# time components (ms), in frozen emit order; the keys public consumers
+# iterate (tools/step_breakdown.py re-exports this as its KEYS)
+TIME_KEYS = ("step", "host_wait", "device", "h2d")
+# full frozen key order of the st1 line
+STEP_KEYS = ("step_ms", "host_wait_ms", "device_ms", "h2d_ms", "data_errors")
+
+_ST1_RE = re.compile(r"time:\s+schema=(\w+)\s+(.*)")
+_KV_RE = re.compile(r"(\w+)=([0-9.+-eE]+)")
+_LEGACY_RE = re.compile(
+    r"time: step = ([0-9.]+) ms host_wait = ([0-9.]+) ms "
+    r"device = ([0-9.]+) ms h2d = ([0-9.]+) ms"
+    r"(?: data_errors = ([0-9]+))?")
+
+
+def format_step_line(times_ms: Dict[str, float], data_errors: int) -> str:
+    """The st1 line (sans indentation). `times_ms` uses the train loop's
+    meter keys (step_ms/host_wait_ms/device_ms/h2d_ms)."""
+    parts = ["time:", "schema=" + STEP_SCHEMA]
+    for k in STEP_KEYS[:-1]:
+        parts.append("%s=%.1f" % (k, float(times_ms[k])))
+    parts.append("data_errors=%d" % int(data_errors))
+    return " ".join(parts)
+
+
+def parse_line(line: str) -> Optional[Dict[str, float]]:
+    """One log line -> {"step": ms, "host_wait": ms, "device": ms,
+    "h2d": ms, "data_errors": n} or None (not a step-time line).
+
+    Accepts the st1 schema and the legacy pre-st1 form; unknown st1 keys
+    (appended by a future minor revision) are carried through verbatim.
+    """
+    m = _ST1_RE.search(line)
+    if m:
+        if m.group(1) != STEP_SCHEMA:
+            return None  # an incompatible future schema: skip, don't guess
+        kv = dict(_KV_RE.findall(m.group(2)))
+        if not all(k in kv for k in STEP_KEYS):
+            return None  # torn/truncated line
+        out: Dict[str, float] = {}
+        for k, v in kv.items():
+            key = k[:-3] if k.endswith("_ms") else k
+            try:
+                out[key] = float(v)
+            except ValueError:
+                return None
+        out["data_errors"] = int(out.get("data_errors", 0))
+        return out
+    m = _LEGACY_RE.search(line)
+    if m:
+        out = {k: float(v) for k, v in zip(TIME_KEYS, m.groups()[:4])}
+        out["data_errors"] = int(m.group(5) or 0)
+        return out
+    return None
+
+
+def parse_lines(lines: Iterable[str]) -> Dict[str, List[float]]:
+    """Aggregate many log lines -> {time key: [ms samples...]} over the four
+    TIME_KEYS (the tools/step_breakdown.py contract; data_errors is
+    per-line via parse_line for consumers that want it)."""
+    samples: Dict[str, List[float]] = {k: [] for k in TIME_KEYS}
+    for line in lines:
+        rec = parse_line(line)
+        if rec is None:
+            continue
+        for k in TIME_KEYS:
+            samples[k].append(rec[k])
+    return samples

@@ -39,6 +39,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from mine_tpu import telemetry
 from mine_tpu.train.state import TrainState
 
 LATEST_NAME = "checkpoint_latest"
@@ -203,17 +204,22 @@ class CheckpointManager:
     def save_latest(self, state: TrainState):
         """Rolling checkpoint (reference: checkpoint_latest.pth every 5000
         steps, synthesis_task.py:625-632)."""
-        # an in-flight mirror may still be reading checkpoint_latest;
-        # finish (or kill) it before force-overwriting its source
-        self._reap_mirror(block=True)
-        self._flush_commits()
-        path = self._path(LATEST_NAME)
-        # the old marker must not certify the dir while the overwrite is in
-        # flight — a crash mid-save then correctly reads as uncommitted
-        self._remove_marker(path)
-        self._ckptr.save(path, self._save_tree(state), force=True)
-        self._pending_commits.append((path, int(state.step)))
-        self._mirror(path)
+        # the span covers dispatch only — the save itself is async, so
+        # this measures how long the TPU-side loop was actually held up
+        # (mirror reap + previous-save settle + save dispatch)
+        with telemetry.span("ckpt.save_latest", step=int(state.step)):
+            # an in-flight mirror may still be reading checkpoint_latest;
+            # finish (or kill) it before force-overwriting its source
+            self._reap_mirror(block=True)
+            self._flush_commits()
+            path = self._path(LATEST_NAME)
+            # the old marker must not certify the dir while the overwrite
+            # is in flight — a crash mid-save then correctly reads as
+            # uncommitted
+            self._remove_marker(path)
+            self._ckptr.save(path, self._save_tree(state), force=True)
+            self._pending_commits.append((path, int(state.step)))
+            self._mirror(path)
 
     def save_step(self, state: TrainState):
         """Immutable per-eval checkpoint — unlike the reference's, it keeps
@@ -221,19 +227,20 @@ class CheckpointManager:
         with a commit marker is final and skipped; a marker-less dir is a
         partial save from a crashed run and is overwritten (the old
         os.path.exists guard refused to ever re-save that step)."""
-        self._flush_commits()
-        path = self._path(STEP_FMT % int(state.step))
-        if os.path.exists(path):
-            if self.has_marker(path):
-                return
-            self._warn("overwriting incomplete step checkpoint %s "
-                       "(no commit marker — previous save did not finish)",
-                       path)
-        self._reap_mirror(block=True)  # one uploader at a time
-        self._ckptr.save(path, self._save_tree(state), force=True)
-        self._pending_commits.append((path, int(state.step)))
-        self._mirror(path)
-        self._retain()
+        with telemetry.span("ckpt.save_step", step=int(state.step)):
+            self._flush_commits()
+            path = self._path(STEP_FMT % int(state.step))
+            if os.path.exists(path):
+                if self.has_marker(path):
+                    return
+                self._warn("overwriting incomplete step checkpoint %s "
+                           "(no commit marker — previous save did not "
+                           "finish)", path)
+            self._reap_mirror(block=True)  # one uploader at a time
+            self._ckptr.save(path, self._save_tree(state), force=True)
+            self._pending_commits.append((path, int(state.step)))
+            self._mirror(path)
+            self._retain()
 
     def wait(self):
         self._flush_commits()
@@ -279,6 +286,11 @@ class CheckpointManager:
         the next instead of killing the run. Markers are advisory here
         (pre-marker workspaces restore fine). Only when every candidate
         fails does the chain raise, with the config-mismatch hint."""
+        with telemetry.span("ckpt.restore"):
+            return self._restore(template, name)
+
+    def _restore(self, template: TrainState,
+                 name: Optional[str] = None) -> Optional[TrainState]:
         self._flush_commits()
         if name is not None:
             path = name if os.path.isabs(name) else self._path(name)
@@ -306,6 +318,14 @@ class CheckpointManager:
                 last = (path, e)
                 continue
             if last is not None:
+                # a corrupt/partial candidate was skipped: count it — a
+                # nonzero ckpt.restore_fallback after an incident review
+                # means the durability story was load-bearing, not luck
+                telemetry.counter("ckpt.restore_fallback").inc()
+                telemetry.emit(
+                    "ckpt.restore_fallback", restored=path,
+                    step=int(np.asarray(restored.step)),
+                    failed=last[0], error=f"{type(last[1]).__name__}")
                 self._warn("restored fallback checkpoint %s at step %d",
                            path, int(np.asarray(restored.step)))
             return restored

@@ -12,6 +12,7 @@ checkpoint), same meters and tensorboard tags, but:
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from typing import Dict, Optional
 
@@ -19,8 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mine_tpu import telemetry
 from mine_tpu.config import (resilience_config_from_dict,
-                             serve_config_from_dict)
+                             serve_config_from_dict,
+                             telemetry_config_from_dict)
 from mine_tpu.data.common import PIPELINE_STATS, RetryPolicy, set_retry_policy
 # prefetch is re-exported here for backward compatibility; it moved to the
 # input-pipeline module alongside the threaded assembler + device stager
@@ -43,6 +46,9 @@ TRAIN_METER_KEYS = ("loss", "loss_rgb_src", "loss_ssim_src",
 #   device     step minus host_wait (device compute + dispatch backpressure)
 #   h2d        host->device copy of the step's batch, measured in the
 #              stager thread (overlapped with compute unless host-bound)
+# Printed per log interval as the FROZEN st1 step-time line
+# (telemetry/stepline.py) and mirrored into the telemetry registry's
+# train.* histograms + the JSONL event stream ("train.step" events).
 TIME_METER_KEYS = ("step_ms", "host_wait_ms", "device_ms", "h2d_ms")
 
 
@@ -119,6 +125,25 @@ class TrainLoop:
         # where the split eval step needs no collectives and the pyramid is
         # a pure function of (src, disparity): otherwise fall back to the
         # fused eval_step with a logged reason.
+        # --- telemetry (mine_tpu/telemetry; README "Observability") ---
+        # events: low-frequency JSONL records (step-time at log cadence,
+        # checkpoint spans, guard aborts, profiler windows); metrics: the
+        # process registry obs_report/serve share. An outer harness that
+        # exported MINE_TPU_TELEMETRY_EVENTS keeps owning the stream.
+        self.telem = telemetry_config_from_dict(self.config)
+        if self.telem.enabled:
+            telemetry.ensure_configured(
+                self.telem.events_path
+                or os.path.join(workspace, "events.jsonl"))
+        # opt-in jax.profiler window over an exact step range, lead host
+        # only (a per-host trace dir free-for-all helps nobody)
+        self.profile = telemetry.ProfileWindow(
+            self.telem.profile_steps if (self.telem.enabled
+                                         and jax.process_index() == 0)
+            else (),
+            self.telem.profile_dir or os.path.join(workspace, "profile"),
+            logger)
+
         self.serve_cfg = serve_config_from_dict(self.config)
         self.eval_encode_once = bool(self.serve_cfg.eval_encode_once)
         if self.eval_encode_once:
@@ -184,6 +209,13 @@ class TrainLoop:
             self.ckpt.wait()
         finally:
             self.preempt.uninstall()
+            self.profile.stop()  # a window whose stop step never arrived
+            # one end-of-run registry snapshot into the event stream so
+            # obs_report sees final counter values without scraping logs
+            telemetry.emit(
+                "metrics.snapshot", scope="train.run_end",
+                gstep=int(state.step),
+                metrics=telemetry.REGISTRY.snapshot())
         return state
 
     # ---------------- epoch ----------------
@@ -264,10 +296,15 @@ class TrainLoop:
                 break
             host_wait_s += time.perf_counter() - t0
             h2d_ms_acc += sb.h2d_ms
+            # profiler window edges (telemetry.profile_steps; cheap int
+            # compares when disabled): trace starts before step `start`
+            # dispatches and stops after step `stop` completes
+            self.profile.maybe_start(gstep + 1)
             state, metrics = self.trainer.train_step(state, sb.batch)
             step_in_epoch += 1
             gstep += 1
             steps_since_log += 1
+            self.profile.maybe_stop(gstep)
             faults.maybe_sigterm(gstep)  # chaos-test seam (no-op unplanned)
 
             at_log = step_in_epoch % self.log_interval == 0
@@ -284,6 +321,8 @@ class TrainLoop:
                 except resilience.GuardAbort:
                     # params are still at their last good values (the guard
                     # zero-updates poisoned steps) — save them before dying
+                    telemetry.counter("train.guard.aborts").inc()
+                    telemetry.emit("train.guard_abort", gstep=gstep, **gm)
                     self.ckpt.save_latest(state)
                     self.ckpt.wait()
                     raise
@@ -519,23 +558,41 @@ class TrainLoop:
     def _log_training(self, epoch, step, gstep, m, times):
         lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
         data_stats = PIPELINE_STATS.snapshot()
+        # the FROZEN parseable step-time line (schema st1 — see
+        # telemetry/stepline.py; tools/step_breakdown.py and obs_report
+        # both read it through the one shared parser)
+        step_line = telemetry.format_step_line(times,
+                                               data_stats["data_errors"])
         self._log(
             "epoch [%.3d] step [%d] global_step = %d total_loss = %.4f "
             "encoder_lr = %.7f step_time = %.3fs\n"
             "        src: rgb = %.4f ssim = %.4f disp_pt3d = %.4f\n"
             "        tgt: rgb = %.4f ssim = %.4f disp_pt3d = %.4f psnr = %.2f\n"
-            # parseable pipeline breakdown (tools/step_breakdown.py);
-            # data_errors is the cumulative failed-item-load count
-            # (data/common.PIPELINE_STATS) — 0 on a healthy run
-            "        time: step = %.1f ms host_wait = %.1f ms "
-            "device = %.1f ms h2d = %.1f ms data_errors = %d"
+            "        %s"
             % (epoch, step, gstep, m["loss"], lrs["backbone"],
                times["step_ms"] / 1e3,
                m["loss_rgb_src"], m["loss_ssim_src"], m["loss_disp_pt3dsrc"],
                m["loss_rgb_tgt"], m["loss_ssim_tgt"], m["loss_disp_pt3dtgt"],
-               m["psnr_tgt"],
-               times["step_ms"], times["host_wait_ms"], times["device_ms"],
-               times["h2d_ms"], data_stats["data_errors"]))
+               m["psnr_tgt"], step_line))
+        if self.telem.enabled:
+            # registry mirror: per-interval time breakdown histograms, the
+            # guard's cumulative counters as gauges (they live in the
+            # TrainState buffer; the registry mirrors at log cadence only —
+            # no new per-step host sync), pipeline health gauges
+            for k in TIME_METER_KEYS:
+                telemetry.histogram("train." + k).record(times[k])
+            for src_key, gauge_name in (
+                    ("skipped_steps", "train.guard.skipped_steps"),
+                    ("guard_consecutive", "train.guard.consecutive"),
+                    ("warp_fallback_frac", "train.warp_fallback_frac")):
+                if src_key in m:
+                    telemetry.gauge(gauge_name).set(m[src_key])
+            telemetry.emit(
+                "train.step", gstep=gstep, epoch=epoch,
+                loss=round(float(m["loss"]), 6),
+                psnr_tgt=round(float(m.get("psnr_tgt", 0.0)), 4),
+                **{k: round(times[k], 3) for k in TIME_METER_KEYS},
+                data_errors=data_stats["data_errors"])
         for k, meter in self.time_meters.items():
             meter.update(times[k])
             self._tb("add_scalar", "time/" + k, times[k], gstep)

@@ -57,6 +57,7 @@ def main():
     import numpy as np
     import yaml
 
+    from mine_tpu import telemetry
     from mine_tpu.config import (CONFIG_DIR, load_config, postprocess,
                                  serve_config_from_dict)
     from mine_tpu.infer.video import (WARP_BAND, VideoGenerator,
@@ -68,6 +69,10 @@ def main():
 
     os.makedirs(args.output_dir, exist_ok=True)
     logger = make_logger(os.path.join(args.output_dir, "serve.log"))
+    # event stream next to the log (telemetry.events_path / the
+    # MINE_TPU_TELEMETRY_EVENTS env var override both win over this)
+    telemetry.ensure_configured(
+        os.path.join(args.output_dir, "events.jsonl"))
 
     ckpt_dir = os.path.dirname(os.path.abspath(args.checkpoint_path))
     params_yaml = os.path.join(ckpt_dir, "params.yaml")
@@ -136,12 +141,17 @@ def main():
 
     stats = engine.cache.stats()
     logger.info("serve stats: entries=%d nbytes=%d hits=%d misses=%d "
-                "evictions=%d quant=%s device_calls=%d",
+                "evictions=%d quant=%s device_calls=%d sync_encodes=%d",
                 stats["entries"], stats["nbytes"], stats["hits"],
                 stats["misses"], stats["evictions"], stats["quant"],
-                engine.device_calls)
+                engine.device_calls, engine.sync_encodes)
     logger.info("rendered %d views from %d images in %.2fs (%.2f views/s)",
                 views, len(paths), dt, views / max(dt, 1e-9))
+    telemetry.emit("serve.stats", views=views, images=len(paths),
+                   seconds=round(dt, 3), device_calls=engine.device_calls,
+                   sync_encodes=engine.sync_encodes, **stats)
+    telemetry.emit("metrics.snapshot", scope="serve_cli_end",
+                   metrics=telemetry.REGISTRY.snapshot("serve."))
 
 
 if __name__ == "__main__":

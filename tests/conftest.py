@@ -55,6 +55,7 @@ QUICK = {
     "test_loss_aggregation.py::test_compute_scale_factor_formula",
     "test_fused_loss.py::test_ssim_pairs_matches_separate_calls",
     "test_step_breakdown.py::test_parse_extracts_all_buckets",
+    "test_telemetry.py::test_histogram_quantiles_match_numpy",
     "test_losses.py::test_psnr_analytic",
     "test_mesh.py::test_num_slices",
     "test_models.py::test_positional_encoding_matches_reference_formula",
@@ -103,6 +104,10 @@ MEDIUM_FILES = {
     # video path): what a reviewer most wants re-run after touching warp or
     # compositing (~30 s of the tier's budget)
     "test_serve.py",
+    # the telemetry layer's contracts (histogram math, event schema, the
+    # frozen st1 step line, bitwise-unchanged instrumented paths): cheap
+    # (~25 s) and every other subsystem now routes through it
+    "test_telemetry.py",
     # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
     # eval): the closest thing to a real-data rehearsal, gated here so it
     # can't rot (round-4 VERDICT item 8; ~5 min of the tier's budget)

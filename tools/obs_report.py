@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Render one observability report from the telemetry surfaces.
+
+Consumes the two parseable streams the telemetry layer emits:
+
+  * the JSONL event stream (mine_tpu/telemetry/events.py — train loop,
+    serve engine/batcher, checkpointing, chaos runs all funnel here), and
+  * optionally a training log, whose frozen st1 step-time lines go through
+    the ONE shared parser (mine_tpu.telemetry.stepline — the same one
+    tools/step_breakdown.py uses).
+
+and prints: event counts by kind, span wall-clock stats (count/mean/p50/
+p90/p99 per span path), step-time aggregates, serve bucket-compile history,
+profiler trace windows, and the final metrics snapshot if one was emitted.
+
+Usage:
+  python tools/obs_report.py EVENTS.jsonl [--log TRAIN.log ...]
+  python tools/obs_report.py EVENTS.jsonl --validate   # schema check only
+
+--validate exits nonzero when any line violates the mtpu-ev1 schema —
+tools/verify_tier1.sh runs this over the event stream the test suite emits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter as TallyCounter
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mine_tpu.telemetry import events as tevents  # noqa: E402
+from mine_tpu.telemetry import stepline  # noqa: E402
+
+
+def _pct(vals, q):
+    if not vals:
+        return float("nan")
+    s = sorted(vals)
+    i = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[i]
+
+
+def _stat_row(name, vals):
+    return ("  %-32s %7d %9.1f %9.1f %9.1f %9.1f"
+            % (name, len(vals), sum(vals) / len(vals),
+               _pct(vals, 0.5), _pct(vals, 0.9), _pct(vals, 0.99)))
+
+
+def report(events, log_lines):
+    out = []
+    kinds = TallyCounter(e.get("kind", "?") for e in events)
+    out.append("events by kind (%d total):" % len(events))
+    for kind, n in sorted(kinds.items()):
+        out.append("  %-32s %7d" % (kind, n))
+
+    spans = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "span" and isinstance(e.get("ms"), (int, float)):
+            spans[e.get("name", "?")].append(float(e["ms"]))
+    if spans:
+        out.append("")
+        out.append("span wall-clock (ms):")
+        out.append("  %-32s %7s %9s %9s %9s %9s"
+                   % ("span", "count", "mean", "p50", "p90", "p99"))
+        for name in sorted(spans):
+            out.append(_stat_row(name, spans[name]))
+
+    steps = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "train.step":
+            for k in stepline.STEP_KEYS[:-1]:
+                if isinstance(e.get(k), (int, float)):
+                    steps[k].append(float(e[k]))
+    for line in log_lines:
+        rec = stepline.parse_line(line)
+        if rec:
+            for k in stepline.TIME_KEYS:
+                steps[k + "_ms"].append(rec[k])
+    if steps:
+        out.append("")
+        out.append("step-time (train.step events + st1 log lines, ms):")
+        out.append("  %-32s %7s %9s %9s %9s %9s"
+                   % ("component", "count", "mean", "p50", "p90", "p99"))
+        for k in stepline.STEP_KEYS[:-1]:
+            if steps.get(k):
+                out.append(_stat_row(k, steps[k]))
+
+    compiles = [e for e in events if e.get("kind") == "serve.bucket_compile"]
+    if compiles:
+        out.append("")
+        out.append("serve bucket compiles (%d):" % len(compiles))
+        for e in compiles:
+            out.append("  R=%-4s P=%-4s %-12s %-10s %8.0f ms"
+                       % (e.get("entries_bucket"), e.get("poses_bucket"),
+                          e.get("warp_impl"), e.get("dtype"),
+                          float(e.get("compile_ms", 0.0))))
+
+    windows = [e for e in events if e.get("kind") == "profile.window"]
+    for e in windows:
+        out.append("")
+        out.append("profiler trace (steps %s..%s): %s"
+                   % (e.get("start_step"), e.get("stop_step"),
+                      e.get("trace_dir")))
+
+    snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
+    if snaps:
+        last = snaps[-1]
+        out.append("")
+        out.append("final metrics snapshot (scope=%s):" % last.get("scope"))
+        for name, v in sorted((last.get("metrics") or {}).items()):
+            if isinstance(v, dict):  # histogram stat dict
+                v = json.dumps(v, sort_keys=True)
+            out.append("  %-32s %s" % (name, v))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Summarize a mine_tpu telemetry event stream")
+    parser.add_argument("events", help="JSONL event file (mtpu-ev1)")
+    parser.add_argument("--log", action="append", default=[],
+                        help="training log(s) to fold step-time lines from")
+    parser.add_argument("--validate", action="store_true",
+                        help="schema-check only; exit 1 on any invalid line")
+    args = parser.parse_args(argv)
+
+    if args.validate:
+        errors = tevents.validate_file(args.events)
+        for err in errors:
+            print(err, file=sys.stderr)
+        print("%s: %s" % (args.events,
+                          "OK" if not errors else
+                          "%d invalid line(s)" % len(errors)))
+        return 1 if errors else 0
+
+    events = tevents.read_events(args.events)
+    log_lines = []
+    for p in args.log:
+        with open(p) as f:
+            log_lines.extend(f.readlines())
+    print(report(events, log_lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

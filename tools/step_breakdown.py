@@ -1,9 +1,11 @@
 #!/usr/bin/env python
 """Summarize the train loop's per-step time breakdown from a training log.
 
-The loop prints, at every log interval (train/loop.py _log_training):
+The loop prints, at every log interval (train/loop.py _log_training), the
+frozen st1 step-time line (mine_tpu/telemetry/stepline.py):
 
-    time: step = 812.0 ms host_wait = 590.1 ms device = 221.9 ms h2d = 35.2 ms
+    time: schema=st1 step_ms=812.0 host_wait_ms=590.1 device_ms=221.9 \
+h2d_ms=35.2 data_errors=0
 
 This tool aggregates those lines into count/mean/p50/p90 per component and
 reports the host-bound fraction — the share of wall-clock the chip spent
@@ -11,32 +13,26 @@ waiting on the input pipeline. Use it to decide which pipeline knob to turn:
 high host_wait with low h2d means assembly-bound (raise data.num_workers);
 host_wait tracking h2d means copy-bound (raise data.staging_buffers).
 
+Parsing goes through the ONE shared parser in mine_tpu.telemetry.stepline
+(no private regex here anymore), which also accepts the legacy pre-st1
+"time: step = 812.0 ms ..." form, so logs from older runs keep summarizing.
+
 Usage: python tools/step_breakdown.py LOGFILE [LOGFILE ...]
        ... | python tools/step_breakdown.py -
 """
 
 from __future__ import annotations
 
-import re
+import os
 import sys
 
-LINE_RE = re.compile(
-    r"time: step = ([0-9.]+) ms host_wait = ([0-9.]+) ms "
-    r"device = ([0-9.]+) ms h2d = ([0-9.]+) ms")
+# runnable from anywhere (python tools/step_breakdown.py): the shared
+# parser lives in the package, so the repo root must be importable
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-KEYS = ("step", "host_wait", "device", "h2d")
+from mine_tpu.telemetry.stepline import TIME_KEYS, parse_lines  # noqa: E402
 
-
-def parse_lines(lines):
-    """-> dict of key -> list of ms samples, one entry per breakdown line."""
-    samples = {k: [] for k in KEYS}
-    for line in lines:
-        m = LINE_RE.search(line)
-        if not m:
-            continue
-        for k, v in zip(KEYS, m.groups()):
-            samples[k].append(float(v))
-    return samples
+KEYS = TIME_KEYS
 
 
 def _pct(sorted_vals, q):

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""CI gate: schema-validate telemetry event streams (mtpu-ev1).
+
+Every line of every given file must satisfy mine_tpu.telemetry.events'
+schema (valid JSON object, schema/ts/kind fields, known schema tag); blank
+lines are tolerated. Exit 0 when clean, 1 with per-line errors on stderr
+otherwise. tools/verify_tier1.sh runs this over the event stream the test
+suite emits via MINE_TPU_TELEMETRY_EVENTS, so a subsystem that starts
+writing malformed events fails tier-1 loudly instead of silently producing
+an unparseable stream.
+
+Usage: python tools/validate_events.py EVENTS.jsonl [MORE.jsonl ...]
+       (a missing file is an error — the caller asserting a stream exists
+        is part of the check; pass --allow-missing to tolerate it)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mine_tpu.telemetry.events import validate_file  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Schema-validate mtpu-ev1 JSONL event files")
+    parser.add_argument("files", nargs="+")
+    parser.add_argument("--allow-missing", action="store_true",
+                        help="treat a nonexistent file as vacuously valid")
+    args = parser.parse_args(argv)
+
+    failed = False
+    for path in args.files:
+        if not os.path.exists(path):
+            if args.allow_missing:
+                print("%s: missing (allowed)" % path)
+                continue
+            print("%s: no such file" % path, file=sys.stderr)
+            failed = True
+            continue
+        errors = validate_file(path)
+        if errors:
+            failed = True
+            for err in errors:
+                print("%s: %s" % (path, err), file=sys.stderr)
+        print("%s: %s" % (path, "OK" if not errors
+                          else "%d invalid line(s)" % len(errors)))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,11 +32,23 @@ UPDATE_BASELINE=0
 [ "${1:-}" = "--update-baseline" ] && UPDATE_BASELINE=1
 
 LOG=/tmp/_t1.log
-rm -f "$LOG"
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -rX \
+EVENTS=/tmp/_t1_events.jsonl
+rm -f "$LOG" "$EVENTS"
+# funnel every telemetry event the suite emits into one stream so the
+# schema-validation pass below can gate on it (events are additive — the
+# suite behaves identically with or without the sink)
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    MINE_TPU_TELEMETRY_EVENTS="$EVENTS" python -m pytest tests/ -q -rX \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
+
+# every line of the event stream must satisfy the mtpu-ev1 schema — a
+# subsystem that emits malformed events fails tier-1 loudly here
+if ! python tools/validate_events.py --allow-missing "$EVENTS"; then
+    echo "EVENT_SCHEMA: telemetry event stream failed validation ($EVENTS)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
 
 # 'X' (xpass) joins the dot classes so an xpassing line can't silently
 # swallow its neighbors' dots from the count

@@ -488,14 +488,16 @@ def _measure_warppass(name, steps=MEASURE_STEPS, keep_run=False):
     return sep_ips, sep_tflops, (sep_run if keep_run else None), batch_size
 
 
-def _serve_bench_engine(trainer, state, batch, max_bucket=8):
+def _serve_bench_engine(trainer, state, batch, max_bucket=8, mesh_batch=1):
     """(engine, image_id, encode_fn) for the serving-engine rows: one
     synthetic MPI cached under the default bf16 quant, the engine wired the
-    way serve_cli wires it (composite backend by platform)."""
+    way serve_cli wires it (composite backend by platform). mesh_batch > 1
+    builds a MeshRenderEngine spanning that many devices on the "batch"
+    axis instead (the --mesh fleet rows)."""
     import jax
 
     from mine_tpu.kernels import on_tpu_backend
-    from mine_tpu.serve import MPICache, RenderEngine
+    from mine_tpu.serve import MeshRenderEngine, MPICache, RenderEngine
     from mine_tpu.train.step import sample_disparity
 
     cfg = trainer.cfg
@@ -512,7 +514,7 @@ def _serve_bench_engine(trainer, state, batch, max_bucket=8):
     encode_jit = jax.jit(encode)
     mpi = jax.block_until_ready(encode_jit(batch["src_img"], disparity))
 
-    engine = RenderEngine(
+    engine_kw = dict(
         use_alpha=cfg.use_alpha,
         is_bg_depth_inf=cfg.is_bg_depth_inf,
         backend="pallas" if on_tpu_backend() else "xla",
@@ -520,6 +522,8 @@ def _serve_bench_engine(trainer, state, batch, max_bucket=8):
         warp_sep_tol=cfg.warp_sep_tol,
         max_bucket=max_bucket,
         cache=MPICache(quant="bf16"))
+    engine = (MeshRenderEngine(mesh_batch=mesh_batch, **engine_kw)
+              if mesh_batch > 1 else RenderEngine(**engine_kw))
     image_id = "bench"
     engine.put(image_id, mpi[0, :, 0:3], mpi[0, :, 3:4], disparity[0],
                batch["K_src"][0])
@@ -607,6 +611,25 @@ def _measure_renderpass(name, steps=MEASURE_STEPS, keep_run=False):
 SERVE_AMORTIZE_VIEWS = (1, 2, 4, 8, 16, 32, 64)
 
 
+def _bench_mesh_sizes():
+    """Fleet sizes for the serve-row mesh sweep: the MINE_TPU_BENCH_MESH
+    env var (set from the --mesh CLI flag; bench children inherit it),
+    validated pow2. Empty when --mesh wasn't given — the serve rows then
+    keep their exact legacy single-device output."""
+    raw = os.environ.get("MINE_TPU_BENCH_MESH", "")
+    sizes = []
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        n = int(tok)
+        if n < 1 or (n & (n - 1)):
+            raise ValueError(
+                "--mesh fleet sizes must be powers of two >= 1, got %r" % tok)
+        sizes.append(n)
+    return sizes
+
+
 def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
     """Encode-amortization curve (the serve_amortize variant).
 
@@ -617,7 +640,14 @@ def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
     architecture is buying. Printed as one parseable stderr line
     ("serve_amortize curve: v:views_per_sec ..."); JSON ips is the v=64
     reading, tflops_per_step the full v=64 trial (1 encode + 64 renders)
-    with batch=64 so the physics audit prices the whole trial."""
+    with batch=64 so the physics audit prices the whole trial.
+
+    With --mesh (MINE_TPU_BENCH_MESH), one EXTRA parseable line per fleet
+    size — "serve_amortize[mesh=N] curve: v:views_per_sec_per_chip ..." —
+    times the same trial through a MeshRenderEngine spanning N devices on
+    the "batch" axis and divides by N: the per-chip efficiency a fleet
+    operator compares against the single-device row. Fleet sizes exceeding
+    the visible device count are skipped with a loud stderr note."""
     import jax
 
     trainer, state, batch = build_variant_program(name)
@@ -629,12 +659,12 @@ def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
 
     engine.warmup(image_id)  # pre-compile every pose bucket <= max_bucket
 
-    def one_trial(v):
+    def one_trial(v, eng=engine):
         t0 = time.perf_counter()
         out = jax.block_until_ready(encode_jit(img, disparity))
-        engine.put(image_id, out[0, :, 0:3], out[0, :, 3:4], disparity[0],
-                   batch["K_src"][0])
-        engine.render(image_id, _serve_bench_poses(v))
+        eng.put(image_id, out[0, :, 0:3], out[0, :, 3:4], disparity[0],
+                batch["K_src"][0])
+        eng.render(image_id, _serve_bench_poses(v))
         return time.perf_counter() - t0
 
     curve = []
@@ -644,6 +674,25 @@ def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
     print("  serve_amortize curve: "
           + " ".join("%d:%.3f" % (v, ips) for v, ips in curve)
           + "  (views/s per single-image encode)", file=sys.stderr)
+
+    for n_chips in _bench_mesh_sizes():
+        avail = len(jax.devices())
+        if n_chips > avail:
+            print("  serve_amortize[mesh=%d]: skipped — only %d device(s) "
+                  "visible" % (n_chips, avail), file=sys.stderr)
+            continue
+        m_engine = engine if n_chips == 1 else _serve_bench_engine(
+            trainer, state, batch, max_bucket=max_bucket,
+            mesh_batch=n_chips)[0]
+        m_engine.warmup(image_id)
+        m_curve = []
+        for v in SERVE_AMORTIZE_VIEWS:
+            t = min(one_trial(v, m_engine) for _ in range(repeats))
+            m_curve.append((v, v / t / n_chips))
+        print("  serve_amortize[mesh=%d] curve: " % n_chips
+              + " ".join("%d:%.3f" % (v, ips) for v, ips in m_curve)
+              + "  (views/s PER CHIP, %d-device fleet)" % n_chips,
+              file=sys.stderr)
 
     v_max = SERVE_AMORTIZE_VIEWS[-1]
     tflops = None
@@ -681,7 +730,14 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
     exactly as a real client would see it. Reported per rate: p50/p99
     latency and achieved QPS (n / last-completion); the knee is the
     highest offered rate still achieving >= 0.9x offered. Each point also
-    lands in the telemetry event stream ("serve.slo_point")."""
+    lands in the telemetry event stream ("serve.slo_point").
+
+    With --mesh (MINE_TPU_BENCH_MESH), the full calibrate+sweep repeats
+    per fleet size through a MeshRenderEngine, printing
+    "serve_slo[mesh=N] curve/knee" lines (mesh=N also lands in the
+    slo_point events); fleet sizes exceeding the device count are skipped
+    loudly. The JSON ips stays the legacy single-device knee."""
+    import jax
     import numpy as np
 
     from mine_tpu.serve.batcher import MicroBatcher
@@ -690,66 +746,87 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
     max_bucket = 8
     engine, image_id, _, _, _ = _serve_bench_engine(
         trainer, state, batch, max_bucket=max_bucket)
-    engine.warmup(image_id)  # compiles never pollute a latency percentile
-
-    # closed-loop calibration: full-bucket renders -> views/s capacity
     poses = _serve_bench_poses(max_bucket)
-    calls = 2 if SMOKE else 10
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        engine.render(image_id, poses)
-    base_qps = calls * max_bucket / (time.perf_counter() - t0)
-
     n_req = 24 if SMOKE else 64
-    rng = np.random.RandomState(0)  # fixed schedule: reruns are comparable
-    curve = []  # (offered, p50_ms, p99_ms, achieved)
-    for frac in SERVE_SLO_RATE_FRACS:
-        offered = base_qps * frac
-        sched = np.cumsum(rng.exponential(1.0 / offered, size=n_req))
-        batcher = MicroBatcher(engine, max_requests=max_bucket,
-                               max_wait_ms=2.0)
-        done_at = [None] * n_req
 
-        def _cb(i):
-            def record(_fut, _i=i):
-                done_at[_i] = time.perf_counter()
-            return record
+    def sweep(eng, tag, chips):
+        """Calibrate + Poisson-sweep one engine; returns (knee, base_qps)."""
+        eng.warmup(image_id)  # compiles never pollute a latency percentile
 
-        futs = []
-        t_start = time.perf_counter()
-        for i in range(n_req):
-            # open loop: sleep until the SCHEDULED arrival — never longer
-            # because the server is behind (that is the whole point)
-            lag = sched[i] - (time.perf_counter() - t_start)
-            if lag > 0:
-                time.sleep(lag)
-            fut = batcher.submit(image_id, poses[i % max_bucket])
-            fut.add_done_callback(_cb(i))
-            futs.append(fut)
-        for fut in futs:
-            fut.result()
-        batcher.close()
-        lat_ms = np.asarray(
-            [(done_at[i] - t_start - sched[i]) * 1e3 for i in range(n_req)])
-        achieved = n_req / (max(done_at) - t_start)
-        p50, p99 = np.percentile(lat_ms, [50, 99])
-        curve.append((offered, float(p50), float(p99), achieved))
-        from mine_tpu import telemetry
-        telemetry.emit("serve.slo_point", offered_qps=round(offered, 3),
-                       p50_ms=round(float(p50), 3),
-                       p99_ms=round(float(p99), 3),
-                       achieved_qps=round(achieved, 3), n_requests=n_req)
+        # closed-loop calibration: full-bucket renders -> views/s capacity
+        calls = 2 if SMOKE else 10
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            eng.render(image_id, poses)
+        base_qps = calls * max_bucket / (time.perf_counter() - t0)
 
-    print("  serve_slo curve: "
-          + " ".join("%.2f:%.1f:%.1f:%.2f" % pt for pt in curve)
-          + "  (offered_qps:p50_ms:p99_ms:achieved_qps)", file=sys.stderr)
-    # highest offered rate the stack still kept up with; when even the
-    # lightest point missed (tiny smoke schedules drown in batcher linger),
-    # fall back to the best achieved rate — the capacity estimate
-    knee = max((pt[0] for pt in curve if pt[3] >= 0.9 * pt[0]),
-               default=max(pt[3] for pt in curve))
-    print("  serve_slo knee: %.2f qps (base closed-loop %.2f views/s)"
-          % (knee, base_qps), file=sys.stderr)
+        rng = np.random.RandomState(0)  # fixed schedule: runs comparable
+        curve = []  # (offered, p50_ms, p99_ms, achieved)
+        for frac in SERVE_SLO_RATE_FRACS:
+            offered = base_qps * frac
+            sched = np.cumsum(rng.exponential(1.0 / offered, size=n_req))
+            batcher = MicroBatcher(eng, max_requests=max_bucket,
+                                   max_wait_ms=2.0)
+            done_at = [None] * n_req
+
+            def _cb(i):
+                def record(_fut, _i=i):
+                    done_at[_i] = time.perf_counter()
+                return record
+
+            futs = []
+            t_start = time.perf_counter()
+            for i in range(n_req):
+                # open loop: sleep until the SCHEDULED arrival — never
+                # longer because the server is behind (the whole point)
+                lag = sched[i] - (time.perf_counter() - t_start)
+                if lag > 0:
+                    time.sleep(lag)
+                fut = batcher.submit(image_id, poses[i % max_bucket])
+                fut.add_done_callback(_cb(i))
+                futs.append(fut)
+            for fut in futs:
+                fut.result()
+            batcher.close()
+            lat_ms = np.asarray(
+                [(done_at[i] - t_start - sched[i]) * 1e3
+                 for i in range(n_req)])
+            achieved = n_req / (max(done_at) - t_start)
+            p50, p99 = np.percentile(lat_ms, [50, 99])
+            curve.append((offered, float(p50), float(p99), achieved))
+            from mine_tpu import telemetry
+            telemetry.emit("serve.slo_point", offered_qps=round(offered, 3),
+                           p50_ms=round(float(p50), 3),
+                           p99_ms=round(float(p99), 3),
+                           achieved_qps=round(achieved, 3), n_requests=n_req,
+                           mesh=chips)
+
+        print("  %s curve: " % tag
+              + " ".join("%.2f:%.1f:%.1f:%.2f" % pt for pt in curve)
+              + "  (offered_qps:p50_ms:p99_ms:achieved_qps)",
+              file=sys.stderr)
+        # highest offered rate the stack still kept up with; when even the
+        # lightest point missed (tiny smoke schedules drown in batcher
+        # linger), fall back to the best achieved rate — the capacity
+        # estimate
+        knee = max((pt[0] for pt in curve if pt[3] >= 0.9 * pt[0]),
+                   default=max(pt[3] for pt in curve))
+        print("  %s knee: %.2f qps (base closed-loop %.2f views/s)"
+              % (tag, knee, base_qps), file=sys.stderr)
+        return knee, base_qps
+
+    knee, base_qps = sweep(engine, "serve_slo", 1)
+
+    for n_chips in _bench_mesh_sizes():
+        avail = len(jax.devices())
+        if n_chips > avail:
+            print("  serve_slo[mesh=%d]: skipped — only %d device(s) "
+                  "visible" % (n_chips, avail), file=sys.stderr)
+            continue
+        m_engine = engine if n_chips == 1 else _serve_bench_engine(
+            trainer, state, batch, max_bucket=max_bucket,
+            mesh_batch=n_chips)[0]
+        sweep(m_engine, "serve_slo[mesh=%d]" % n_chips, n_chips)
 
     def run(n):
         t0 = time.perf_counter()
@@ -872,6 +949,15 @@ def _child(name: str, outdir: str) -> None:
         write_result(outdir, payload)
 
     try:
+        mesh_sizes = _bench_mesh_sizes()
+        if SMOKE and mesh_sizes and max(mesh_sizes) > 1:
+            # CPU smoke: the host platform exposes ONE device unless asked
+            # for more — give the child enough virtual devices for the
+            # largest requested fleet (must land before backend init)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=%d"
+                % max(mesh_sizes)).strip()
         import jax
         if SMOKE:
             # smoke is a CPU harness self-test; never touch the chip (env
@@ -1006,6 +1092,30 @@ def main():
     if len(sys.argv) >= 2 and sys.argv[1] == "--child":
         _child(sys.argv[2], sys.argv[3])
         return
+
+    # --mesh [N,N,...] — fleet sizes for the serve rows (default 1,2,4).
+    # Parsed by hand like --child (no argparse in this file); exported as
+    # MINE_TPU_BENCH_MESH so the variant children inherit it.
+    argv = sys.argv[1:]
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--mesh":
+            if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                os.environ["MINE_TPU_BENCH_MESH"] = argv[i + 1]
+                i += 2
+            else:
+                os.environ["MINE_TPU_BENCH_MESH"] = "1,2,4"
+                i += 1
+        elif a.startswith("--mesh="):
+            os.environ["MINE_TPU_BENCH_MESH"] = a.split("=", 1)[1]
+            i += 1
+        else:
+            print("unknown argument %r (only --child and --mesh exist)" % a,
+                  file=sys.stderr)
+            sys.exit(2)
+    if os.environ.get("MINE_TPU_BENCH_MESH"):
+        _bench_mesh_sizes()  # fail fast on malformed sizes, in the parent
 
     only = os.environ.get("MINE_TPU_BENCH_VARIANTS")
     # default run = the flagship headline only: the full sweep is

@@ -213,10 +213,23 @@ class ServeConfig:
     # serve.max_bucket: poses per device call; pose counts pad to
     # power-of-two buckets <= this, bounding the compile set
     max_bucket: int = 8
-    # serve.max_requests / serve.max_wait_ms: micro-batcher coalescing
+    # serve.max_requests / serve.max_wait_ms: request coalescing — the
+    # batch the scheduler fills / the deadline it holds a request to
     # (serve/batcher.py)
     max_requests: int = 8
     max_wait_ms: float = 2.0
+    # serve.mesh_batch / serve.mesh_model: serving mesh axes (pow2) — poses
+    # along "batch", the S plane axis along "model" (serve/shardmap.py);
+    # 1x1 keeps the single-device engine
+    mesh_batch: int = 1
+    mesh_model: int = 1
+    # serve.cache_shards: key-range partition of the plane cache; each
+    # shard owns a contiguous hash range under cache_bytes/shards
+    # (serve/fleet.py)
+    cache_shards: int = 1
+    # serve.scheduler: continuous (deadline loop keeping pow2 buckets
+    # filled, the fleet default) | micro (the PR-5 one-shot linger)
+    scheduler: str = "continuous"
     # serve.eval_encode_once: eval loop encodes each DISTINCT source image
     # once and reuses the cached MPI pyramid for all its target views
     # (single-host, num_bins_fine=0; train/loop.py run_eval)
@@ -234,6 +247,10 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         max_bucket=int(g("serve.max_bucket", 8)),
         max_requests=int(g("serve.max_requests", 8)),
         max_wait_ms=float(g("serve.max_wait_ms", 2.0)),
+        mesh_batch=int(g("serve.mesh_batch", 1)),
+        mesh_model=int(g("serve.mesh_model", 1)),
+        cache_shards=int(g("serve.cache_shards", 1)),
+        scheduler=str(g("serve.scheduler", "continuous")),
         eval_encode_once=bool(g("serve.eval_encode_once", False)),
         eval_cache_quant=str(g("serve.eval_cache_quant", "float32")),
     )
@@ -256,6 +273,20 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
     if out.max_wait_ms < 0:
         raise ValueError(
             f"serve.max_wait_ms must be >= 0, got {out.max_wait_ms}")
+    for key, val in (("serve.mesh_batch", out.mesh_batch),
+                     ("serve.mesh_model", out.mesh_model)):
+        # pow2 mesh axes compose with the engine's pow2 shape buckets:
+        # every bucket divides evenly across the mesh (serve/shardmap.py)
+        if val < 1 or (val & (val - 1)) != 0:
+            raise ValueError(
+                f"{key} must be a power of two >= 1, got {val}")
+    if out.cache_shards < 1:
+        raise ValueError(
+            f"serve.cache_shards must be >= 1, got {out.cache_shards}")
+    if out.scheduler not in ("continuous", "micro"):
+        raise ValueError(
+            f"serve.scheduler must be continuous|micro, "
+            f"got {out.scheduler!r}")
     return out
 
 

@@ -284,7 +284,8 @@ def predict_mpi_coarse_to_fine(mpi_predictor,
                                xyz_src_BS3HW_coarse: jnp.ndarray,
                                disparity_coarse_src: jnp.ndarray,
                                s_fine: int,
-                               is_bg_depth_inf: bool):
+                               is_bg_depth_inf: bool,
+                               fine_rows=None):
     """Optional coarse-to-fine plane placement.
 
     With s_fine > 0: run a stop-gradient coarse pass, convert per-plane mean
@@ -297,6 +298,12 @@ def predict_mpi_coarse_to_fine(mpi_predictor,
     Args:
       mpi_predictor: fn (src_imgs, disparity [B,S]) -> list of 4 per-scale
         MPI volumes [B,S,4,Hs,Ws]
+      fine_rows: optional (full_batch, row) for a per-example caller
+        standing in for rows [row:row+B] of a `full_batch`-sized batched
+        call: the fine-plane uniforms are drawn with `key` at the FULL
+        batch shape and this caller's rows sliced out, so the importance
+        samples match the batched pass's for the same example (the
+        encode-once eval path, train/step.py eval_encode_c2f).
     Returns: (mpi_all_src_list, disparity_all_src [B, S_coarse+s_fine])
     """
     from mine_tpu.ops import sampling  # local import to avoid cycle
@@ -315,8 +322,16 @@ def predict_mpi_coarse_to_fine(mpi_predictor,
         is_bg_depth_inf)
     weights = jnp.mean(weights, axis=(2, 3, 4))[:, None, None, :]  # [B,1,1,S]
 
-    disp_fine = sampling.sample_pdf(
-        key, disparity_coarse_src[:, None, None, :], weights, s_fine)
+    if fine_rows is None:
+        disp_fine = sampling.sample_pdf(
+            key, disparity_coarse_src[:, None, None, :], weights, s_fine)
+    else:
+        full_batch, row = fine_rows
+        u = jax.random.uniform(key, (full_batch, 1, 1, s_fine),
+                               dtype=weights.dtype)
+        u = jax.lax.dynamic_slice_in_dim(u, row, B, axis=0)
+        disp_fine = sampling.sample_pdf_from_u(
+            u, disparity_coarse_src[:, None, None, :], weights)
     disp_fine = disp_fine[:, 0, 0, :]  # [B, s_fine]
 
     disparity_all = jnp.concatenate([disparity_coarse_src, disp_fine], axis=1)

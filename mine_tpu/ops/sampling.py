@@ -74,6 +74,22 @@ def sample_pdf(key: jax.Array,
     Returns: samples [B, 1, N, n_samples]
     """
     B, _, N, S = weights.shape
+    u = jax.random.uniform(key, (B, 1, N, n_samples), dtype=weights.dtype)
+    return sample_pdf_from_u(u, values, weights)
+
+
+def sample_pdf_from_u(u: jnp.ndarray,
+                      values: jnp.ndarray,
+                      weights: jnp.ndarray) -> jnp.ndarray:
+    """Inverse-CDF transform of PRE-DRAWN uniforms `u` [B, 1, N, n].
+
+    The deterministic half of sample_pdf, split out so a caller can draw
+    one batch-level u and feed per-example ROWS of it: the encode-once
+    eval path (train/step.py eval_encode) replays exactly the fine-plane
+    draws the fused batched eval step makes for the same example.
+    """
+    B, _, N, S = weights.shape
+    n_samples = u.shape[-1]
 
     mid = (values[..., 1:] + values[..., :-1]) * 0.5
     bin_edges = jnp.concatenate([values[..., :1], mid, values[..., -1:]], axis=-1)  # [B,1,N,S+1]
@@ -81,8 +97,6 @@ def sample_pdf(key: jax.Array,
     pdf = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-5)
     cdf = jnp.cumsum(pdf, axis=-1)
     cdf = jnp.concatenate([jnp.zeros_like(cdf[..., :1]), cdf], axis=-1)  # [B,1,N,S+1]
-
-    u = jax.random.uniform(key, (B, 1, N, n_samples), dtype=weights.dtype)
 
     # searchsorted over the last axis, batched
     cdf_flat = cdf.reshape(B * N, S + 1)

@@ -2,23 +2,32 @@
 
 MINE predicts an MPI once per image; every novel view after that is warp +
 composite only. This package is the serving-side realization of that
-asymmetry (README "Serving"):
+asymmetry (README "Serving" / "Sharded serving"):
 
-  cache.py    MPICache — LRU of quantized MPI planes under a byte budget
-  engine.py   RenderEngine — shape-bucketed jitted render-only program
-  batcher.py  MicroBatcher — coalesces requests across distinct MPIs
+  cache.py     MPICache — LRU of quantized MPI planes under a byte budget
+  engine.py    RenderEngine — shape-bucketed jitted render-only program
+  batcher.py   MicroBatcher / ContinuousBatcher — request coalescing
+  shardmap.py  serving mesh ("batch","model") + MeshRenderEngine
+  fleet.py     ShardedPlaneCache (key-range partition) + ServeFleet
 
 Configured by the serve.* keys (configs/params_default.yaml,
 config.ServeConfig).
 """
 
-from mine_tpu.serve.batcher import MicroBatcher
+from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   dequantize_planes, image_id_for,
                                   quantize_planes)
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
+from mine_tpu.serve.fleet import ServeFleet, ShardedPlaneCache, shard_for_key
+from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
+                                     MeshRenderEngine, make_serve_mesh,
+                                     render_shardings)
 
 __all__ = [
-    "MPICache", "MPIEntry", "MicroBatcher", "PyramidCache", "RenderEngine",
-    "dequantize_planes", "image_id_for", "pow2_bucket", "quantize_planes",
+    "ContinuousBatcher", "MPICache", "MPIEntry", "MeshRenderEngine",
+    "MicroBatcher", "PyramidCache", "RenderEngine", "SERVE_BATCH_AXIS",
+    "SERVE_MODEL_AXIS", "ServeFleet", "ShardedPlaneCache",
+    "dequantize_planes", "image_id_for", "make_serve_mesh", "pow2_bucket",
+    "quantize_planes", "render_shardings", "shard_for_key",
 ]

@@ -111,3 +111,59 @@ class MicroBatcher:
             self._thread.join(timeout=30)
         while self.flush():
             pass
+
+
+class ContinuousBatcher(MicroBatcher):
+    """Continuous-batching scheduler: keep the engine's pow2 pose buckets
+    filled across in-flight requesters.
+
+    Where MicroBatcher lingers ONCE per wakeup and then flushes whatever
+    is pending, this scheduler runs a deadline loop: a batch dispatches the
+    moment it is FULL (`max_requests`, one complete pow2 bucket), or when
+    the OLDEST pending request's deadline (enqueue + `serve.max_wait_ms`)
+    expires — no request waits past its deadline for co-riders, and a
+    burst never waits at all. Admission is continuous: `submit` only takes
+    the queue lock, which the flush path drops before the device call, so
+    new requests keep boarding while a render is in flight and the next
+    bucket is typically full by the time the engine returns.
+
+    Same queue-wait / coalesce-size histograms as MicroBatcher (the flush
+    path is inherited); `serve.batcher.flush_full` / `flush_deadline`
+    count which trigger fired. Tests drive `_ready` and `flush()` directly
+    with start=False (no timing dependence).
+    """
+
+    def flush(self) -> int:
+        n = super().flush()
+        if n:
+            telemetry.counter(
+                "serve.batcher.flush_full" if n >= self.max_requests
+                else "serve.batcher.flush_deadline").inc()
+        return n
+
+    def _ready(self, now: float) -> bool:
+        """Dispatch decision (callers hold self._cv): full bucket, expired
+        oldest deadline, or an immediate-mode (max_wait_ms=0) queue."""
+        if len(self._pending) >= self.max_requests:
+            return True
+        if not self._pending:
+            return False
+        return (self.max_wait_s <= 0
+                or now >= self._pending[0][3] + self.max_wait_s)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                now = time.perf_counter()
+                if not self._closed and not self._ready(now):
+                    # sleep only to the oldest deadline; a submit that
+                    # fills the bucket notifies earlier. Loop back to
+                    # re-decide instead of flushing blindly on wake.
+                    self._cv.wait(timeout=max(
+                        0.0, self._pending[0][3] + self.max_wait_s - now))
+                    continue
+            self.flush()

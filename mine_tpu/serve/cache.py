@@ -162,6 +162,24 @@ class MPICache:
         _sync_cache_gauges(self)
         return entry
 
+    def adopt(self, image_id: str, entry: MPIEntry) -> MPIEntry:
+        """Insert an ALREADY-quantized entry (a rebalance move between the
+        fleet's cache shards — serve/fleet.py): same replace/budget/eviction
+        semantics as put(), without re-quantizing the planes."""
+        old = self._entries.pop(image_id, None)
+        if old is not None:
+            self.nbytes -= old.nbytes
+        self._entries[image_id] = entry
+        self.nbytes += entry.nbytes
+        if self.capacity_bytes > 0:
+            while self.nbytes > self.capacity_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self.nbytes -= evicted.nbytes
+                self.evictions += 1
+                telemetry.counter(self._METRIC_PREFIX + ".evictions").inc()
+        _sync_cache_gauges(self)
+        return entry
+
     def get(self, image_id: str) -> Optional[MPIEntry]:
         entry = self._entries.get(image_id)
         if entry is None:

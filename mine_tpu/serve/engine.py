@@ -111,6 +111,9 @@ class RenderEngine:
         self.encode_fn = encode_fn
         self.device_calls = 0
         self.sync_encodes = 0
+        # pose buckets never drop below this (the mesh subclass raises it
+        # to its "batch" axis size so buckets split evenly across devices)
+        self._min_pose_bucket = 1
         # (Rb, Pb, warp_impl, planes dtype) keys already dispatched: a
         # first-seen key means jit traces + compiles a new executable —
         # the compile-set growth the pow2 bucketing is meant to bound
@@ -182,13 +185,20 @@ class RenderEngine:
             warp_sep_tol=self.warp_sep_tol)
         return res.rgb, res.depth
 
+    def _place(self, planes, scales, disp, K, K_inv, idx, poses):
+        """Device-placement hook before dispatch. The base engine lets jit
+        commit operands to the default device; the mesh engine
+        (serve/shardmap.py) overrides this to device_put each operand under
+        its NamedSharding so the jitted program spans the serving mesh."""
+        return planes, scales, disp, K, K_inv, idx, poses
+
     def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
               poses: np.ndarray, warp_impl: Optional[str]):
         """Bucket R and P, pad, dispatch ONE device call, slice."""
         t0 = time.perf_counter()
         warp_impl = warp_impl or self.warp_impl
         P = poses.shape[0]
-        Pb = pow2_bucket(P)
+        Pb = max(pow2_bucket(P), self._min_pose_bucket)
         if P < Pb:
             poses = np.concatenate([poses, _identity_poses(Pb - P)], axis=0)
             idx = np.concatenate([idx, np.zeros(Pb - P, idx.dtype)])
@@ -209,9 +219,10 @@ class RenderEngine:
             if scales is not None:
                 scales = pad_r(scales)
         K_inv = geometry.inverse_intrinsics(K)
-        rgb, depth = self._render(planes, scales, disp, K, K_inv,
-                                  jnp.asarray(idx, jnp.int32),
-                                  jnp.asarray(poses), warp_impl)
+        args = self._place(planes, scales, disp, K, K_inv,
+                           jnp.asarray(idx, jnp.int32),
+                           jnp.asarray(poses, jnp.float32))
+        rgb, depth = self._render(*args, warp_impl)
         self.device_calls += 1
         out = np.asarray(rgb[:P]), np.asarray(depth[:P])  # device sync
         elapsed_ms = (time.perf_counter() - t0) * 1e3

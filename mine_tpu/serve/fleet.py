@@ -1,0 +1,266 @@
+"""Serving fleet: key-range-sharded plane cache + mesh engine + scheduler.
+
+The ROADMAP's serving lever is views/sec/chip x chips; this module is the
+"x chips" part assembled from the fleet's three pieces:
+
+  * `ShardedPlaneCache` — the PR-5 content-hash LRU partitioned by KEY
+    RANGE: the id space (the leading 32 bits of the sha1 image id) is cut
+    into `num_shards` contiguous ranges and each shard owns one, with its
+    own byte budget (`serve.cache_bytes / num_shards`). Lookups route to
+    the owner (a front-end shard that doesn't own the key counts a
+    `serve.shard.remote_route`), misses trigger an owner-side encode
+    (`serve.shard.owner_encode` + a `serve.shard.place` event), and a
+    shard-count change rebalances every entry whose range moved
+    (`serve.shard.rebalance`). Ownership is a pure function of
+    (image_id, num_shards) — deterministic across processes, so any
+    front-end routes identically (tests/test_serve_fleet.py).
+  * `MeshRenderEngine` (serve/shardmap.py) — the one jitted render program
+    spanning a ("batch", "model") device mesh.
+  * `ContinuousBatcher` (serve/batcher.py) — keeps the engine's pow2 pose
+    buckets filled across in-flight requesters.
+
+`ServeFleet` wires them per the serve.* config keys and is what serve_cli
+builds when `serve.mesh_batch * serve.mesh_model > 1` or
+`serve.cache_shards > 1`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+from typing import Callable, List, Optional
+
+from mine_tpu import telemetry
+from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
+from mine_tpu.serve.cache import MPICache, MPIEntry
+from mine_tpu.serve.shardmap import MeshRenderEngine
+
+_METRIC_PREFIX = "serve.shard"
+# ownership uses the leading 32 bits of the content hash: wide enough that
+# pow2 AND non-pow2 shard counts cut near-equal ranges, cheap to recompute
+# anywhere (no routing table to distribute)
+_KEY_BITS = 32
+
+
+def _key_pos(image_id: str) -> int:
+    """Position of an id in the [0, 2^32) key space. Content-hash ids
+    (sha1 hex, serve/cache.py image_id_for) use their leading 8 hex digits
+    directly; arbitrary ids (tests, benches) fall back to hashing the id
+    string so every key still lands deterministically in the range."""
+    try:
+        return int(image_id[:8], 16)
+    except ValueError:
+        return int(hashlib.sha1(image_id.encode()).hexdigest()[:8], 16)
+
+
+def shard_for_key(image_id: str, num_shards: int) -> int:
+    """Owner shard of `image_id` under a `num_shards`-way key-range
+    partition: shard s owns [s*2^32/N, (s+1)*2^32/N). Deterministic in
+    (image_id, num_shards) alone."""
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    return (_key_pos(image_id) * num_shards) >> _KEY_BITS
+
+
+class ShardedPlaneCache:
+    """Key-range partition of the MPI plane cache across fleet shards.
+
+    Drop-in for `MPICache` where the engine is concerned (get / put /
+    __contains__ / stats), with the byte budget split evenly across the
+    per-shard LRUs so one hot shard cannot evict another shard's residency.
+    Per-occurrence routing telemetry lands under `serve.shard.*`; the
+    per-shard LRUs keep mirroring the process-wide `serve.cache.*`
+    counters, which therefore aggregate over all shards.
+    """
+
+    def __init__(self, num_shards: int = 1, capacity_bytes: int = 0,
+                 quant: str = "bf16"):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.quant = quant
+        self.shards: List[MPICache] = [
+            MPICache(capacity_bytes=self.capacity_bytes // num_shards
+                     if self.capacity_bytes else 0, quant=quant)
+            for _ in range(num_shards)]
+        self.owner_hits = 0
+        self.remote_routes = 0
+        self.owner_encodes = 0
+        self.rebalances = 0
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    def owner(self, image_id: str) -> int:
+        return shard_for_key(image_id, self.num_shards)
+
+    def route(self, caller_shard: int, image_id: str) -> int:
+        """Front-end routing step: the shard a request lands on forwards
+        the key to its owner; a cross-shard hop is a remote route."""
+        o = self.owner(image_id)
+        if caller_shard != o:
+            self.remote_routes += 1
+            telemetry.counter(_METRIC_PREFIX + ".remote_route").inc()
+        return o
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def __contains__(self, image_id: str) -> bool:
+        return image_id in self.shards[self.owner(image_id)]
+
+    def keys(self):
+        return [k for s in self.shards for k in s.keys()]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for s in self.shards)
+
+    def get(self, image_id: str) -> Optional[MPIEntry]:
+        entry = self.shards[self.owner(image_id)].get(image_id)
+        if entry is not None:
+            self.owner_hits += 1
+            telemetry.counter(_METRIC_PREFIX + ".owner_hit").inc()
+        return entry
+
+    def put(self, image_id: str, mpi_rgb_S3HW, mpi_sigma_S1HW,
+            disparity_S, K_33) -> MPIEntry:
+        """Owner-side placement: the encode result lands on the shard that
+        owns the key's range, never on the shard the request arrived at."""
+        o = self.owner(image_id)
+        entry = self.shards[o].put(image_id, mpi_rgb_S3HW, mpi_sigma_S1HW,
+                                   disparity_S, K_33)
+        self.owner_encodes += 1
+        telemetry.counter(_METRIC_PREFIX + ".owner_encode").inc()
+        telemetry.emit("serve.shard.place", image_id=image_id[:12],
+                       shard=o, shards=self.num_shards, nbytes=entry.nbytes)
+        return entry
+
+    def rebalance(self, num_shards: int) -> int:
+        """Repartition to `num_shards` key ranges, moving every resident
+        entry whose owner changed; returns the move count. The per-shard
+        budget is re-derived from the fleet-level `capacity_bytes`."""
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        old = self.shards
+        per = self.capacity_bytes // num_shards if self.capacity_bytes else 0
+        self.shards = [MPICache(capacity_bytes=per, quant=self.quant)
+                       for _ in range(num_shards)]
+        moved = 0
+        for old_idx, shard in enumerate(old):
+            for image_id in shard.keys():  # LRU order: recency survives
+                entry = shard._entries[image_id]
+                new_idx = self.owner(image_id)
+                self.shards[new_idx].adopt(image_id, entry)
+                moved += int(new_idx != old_idx)
+        self.rebalances += 1
+        telemetry.counter(_METRIC_PREFIX + ".rebalance").inc(moved)
+        telemetry.emit("serve.shard.rebalance", from_shards=len(old),
+                       to_shards=num_shards, moved=moved,
+                       entries=len(self))
+        return moved
+
+    def stats(self) -> dict:
+        agg = {"entries": len(self), "nbytes": self.nbytes,
+               "shards": self.num_shards, "quant": self.quant,
+               "owner_hits": self.owner_hits,
+               "remote_routes": self.remote_routes,
+               "owner_encodes": self.owner_encodes,
+               "rebalances": self.rebalances}
+        for k in ("hits", "misses", "evictions"):
+            agg[k] = sum(s.stats()[k] for s in self.shards)
+        agg["per_shard"] = [
+            {"entries": len(s), "nbytes": s.nbytes} for s in self.shards]
+        return agg
+
+
+class ServeFleet:
+    """Front door of the sharded serving fleet: one mesh render engine over
+    a key-range-sharded cache, fed by the continuous batcher.
+
+    `submit` is the request path (front-end shard assigned round-robin,
+    key routed to its owner, render coalesced by the scheduler); `render` /
+    `render_many` pass through to the engine for trajectory-style callers
+    (serve_cli's video path).
+    """
+
+    def __init__(self, *,
+                 mesh_batch: int = 1,
+                 mesh_model: int = 1,
+                 cache_shards: int = 1,
+                 cache_bytes: int = 0,
+                 cache_quant: str = "bf16",
+                 scheduler: str = "continuous",
+                 max_requests: int = 8,
+                 max_wait_ms: float = 2.0,
+                 max_bucket: int = 8,
+                 encode_fn: Optional[Callable] = None,
+                 start: bool = True,
+                 devices=None,
+                 **engine_kw):
+        self.cache = ShardedPlaneCache(
+            num_shards=cache_shards, capacity_bytes=cache_bytes,
+            quant=cache_quant)
+        self.engine = MeshRenderEngine(
+            mesh_batch=mesh_batch, mesh_model=mesh_model, devices=devices,
+            max_bucket=max_bucket, cache=self.cache, encode_fn=encode_fn,
+            **engine_kw)
+        if scheduler not in ("continuous", "micro"):
+            raise ValueError(
+                f"serve.scheduler must be continuous|micro, got {scheduler!r}")
+        batcher_cls = ContinuousBatcher if scheduler == "continuous" \
+            else MicroBatcher
+        self.batcher = batcher_cls(self.engine, max_requests=max_requests,
+                                   max_wait_ms=max_wait_ms, start=start)
+        self._front = itertools.count()
+
+    @classmethod
+    def from_config(cls, serve_cfg, encode_fn=None, start: bool = True,
+                    devices=None, **engine_kw) -> "ServeFleet":
+        """Build from a config.ServeConfig (the serve.* key block)."""
+        return cls(mesh_batch=serve_cfg.mesh_batch,
+                   mesh_model=serve_cfg.mesh_model,
+                   cache_shards=serve_cfg.cache_shards,
+                   cache_bytes=serve_cfg.cache_bytes,
+                   cache_quant=serve_cfg.cache_quant,
+                   scheduler=serve_cfg.scheduler,
+                   max_requests=serve_cfg.max_requests,
+                   max_wait_ms=serve_cfg.max_wait_ms,
+                   max_bucket=serve_cfg.max_bucket,
+                   encode_fn=encode_fn, start=start, devices=devices,
+                   **engine_kw)
+
+    def num_devices(self) -> int:
+        return self.engine.num_devices()
+
+    def submit(self, image_id: str, pose_44):
+        """One view request through the fleet: round-robin front-end shard,
+        owner routing (telemetry), scheduler coalescing. Resolves to
+        (rgb [3,H,W], depth [1,H,W]) f32 numpy."""
+        caller = next(self._front) % self.cache.num_shards
+        self.cache.route(caller, image_id)
+        return self.batcher.submit(image_id, pose_44)
+
+    def render(self, image_id: str, poses_P44, **kw):
+        return self.engine.render(image_id, poses_P44, **kw)
+
+    def render_many(self, requests, **kw):
+        return self.engine.render_many(requests, **kw)
+
+    def encode(self, img_hwc, image_id: Optional[str] = None) -> str:
+        return self.engine.encode(img_hwc, image_id=image_id)
+
+    def warmup(self, image_id: str, **kw) -> None:
+        self.engine.warmup(image_id, **kw)
+
+    def stats(self) -> dict:
+        s = self.cache.stats()
+        s.update(device_calls=self.engine.device_calls,
+                 sync_encodes=self.engine.sync_encodes,
+                 flushes=self.batcher.flushes,
+                 mesh=f"{self.engine.mesh_batch}x{self.engine.mesh_model}")
+        return s
+
+    def close(self) -> None:
+        self.batcher.close()

@@ -147,17 +147,15 @@ class TrainLoop:
         self.serve_cfg = serve_config_from_dict(self.config)
         self.eval_encode_once = bool(self.serve_cfg.eval_encode_once)
         if self.eval_encode_once:
-            reason = None
+            # Single remaining gate: multi-host (the split eval halves would
+            # need collectives). Single-host mesh>1 works — the plain-jit
+            # eval halves let GSPMD reshard on the fly — and num_bins_fine>0
+            # goes through trainer.eval_encode_c2f, which replays the fused
+            # step's fine-plane draws per example (train/step.py).
             if jax.process_count() > 1:
-                reason = "multi-host run (eval steps are collective)"
-            elif trainer.mesh is not None and trainer.mesh.size > 1:
-                reason = "mesh size > 1 (eval steps are sharded)"
-            elif trainer.cfg.num_bins_fine > 0:
-                reason = ("mpi.num_bins_fine > 0 (coarse-to-fine importance-"
-                          "samples planes per step; pyramids aren't reusable)")
-            if reason is not None:
                 self.eval_encode_once = False
-                self._log("serve.eval_encode_once disabled: %s", reason)
+                self._log("serve.eval_encode_once disabled: %s",
+                          "multi-host run (eval steps are collective)")
 
     # ---------------- top-level ----------------
 
@@ -500,24 +498,38 @@ class TrainLoop:
 
         Derives the SAME per-batch disparity sample as the fused eval step
         (fold_in(eval_rng, i) -> split -> sample_disparity), encodes only
-        source images whose pyramid isn't cached, and runs the batched
+        source images whose pyramid isn't cached (coarse-to-fine configs use
+        the RNG-replaying eval_encode_c2f), and runs the batched
         render+loss half on the replayed pyramids. A source seen again
         reuses its first-seen disparity row — an RNG-level shift vs. the
         fused path (identical when val sources are distinct; the metric-
         parity test runs on a distinct-source set)."""
         B = np_batch["src_img"].shape[0]
-        d_key, _ = jax.random.split(key)  # split mirrors _eval_step_impl
+        d_key, f_key = jax.random.split(key)  # split mirrors _eval_step_impl
         disparity = np.asarray(sample_disparity(d_key, B, self.trainer.cfg))
+        c2f = self.trainer.cfg.num_bins_fine > 0
         rows = []
         for b in range(B):
             img_b = np_batch["src_img"][b:b + 1]
             iid = image_id_for(img_b)
             cached = eval_cache.get(iid)
             if cached is None:
-                mpi_b = self.trainer.eval_encode(
-                    state, jnp.asarray(img_b),
-                    jnp.asarray(disparity[b:b + 1]))
-                eval_cache.put(iid, [m[0] for m in mpi_b], disparity[b])
+                if c2f:
+                    # coarse-to-fine: per-example encode replaying the fused
+                    # step's row-b fine-plane draws (fine_rows slicing in
+                    # ops/rendering.py); cache the FULL coarse+fine
+                    # disparities alongside the pyramid
+                    mpi_b, disp_all_b = self.trainer.eval_encode_c2f(
+                        state, jnp.asarray(img_b),
+                        jnp.asarray(disparity[b:b + 1]), f_key, b,
+                        jnp.asarray(np_batch["K_src"][b:b + 1]), B)
+                    disp_row = np.asarray(disp_all_b[0])
+                else:
+                    mpi_b = self.trainer.eval_encode(
+                        state, jnp.asarray(img_b),
+                        jnp.asarray(disparity[b:b + 1]))
+                    disp_row = disparity[b]
+                eval_cache.put(iid, [m[0] for m in mpi_b], disp_row)
                 cached = eval_cache.get(iid)
             rows.append(cached)
         num_scales = len(rows[0][0])

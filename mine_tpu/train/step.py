@@ -190,8 +190,11 @@ class SynthesisTrainer:
         # the eval step split into its two halves so the host loop can cache
         # the encode per DISTINCT source image (serve.PyramidCache) and pay
         # only the loss/render half per (src, tgt) pair. Gated to
-        # single-host / mesh-size-1 in the loop, so plain jit suffices.
+        # single-host in the loop; plain jit suffices on mesh>1 too (GSPMD
+        # reshards the replicated-state inputs on the fly).
         self._eval_encode = jit(self._eval_encode_impl)
+        self._eval_encode_c2f = jit(self._eval_encode_c2f_impl,
+                                    static_argnames=("batch_size",))
         self._eval_losses = jit(self._eval_losses_impl)
         self._eval_losses_masked = jit(self._eval_losses_masked_impl)
 
@@ -366,11 +369,37 @@ class SynthesisTrainer:
 
     def _eval_encode_impl(self, state: TrainState, src_img, disparity):
         """Encode half of the eval step: model forward only (eval-mode BN,
-        no coarse-to-fine — the encode-once path is gated to
-        mpi.num_bins_fine=0). Returns the 4-scale MPI pyramid."""
+        no coarse-to-fine). Returns the 4-scale MPI pyramid. Configs with
+        mpi.num_bins_fine > 0 go through _eval_encode_c2f_impl instead."""
         return self.model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             src_img, disparity, train=False)
+
+    def _eval_encode_c2f_impl(self, state: TrainState, src_img, disparity,
+                              fine_key, row, K_src, batch_size: int):
+        """Coarse-to-fine encode half for ONE example of a fused eval batch.
+
+        Replays exactly the fine-plane draws the fused _eval_step_impl makes
+        for batch row `row`: the uniforms behind sample_pdf are drawn at the
+        FULL eval-batch shape (`batch_size` static) from `fine_key` and this
+        example's row is sliced out (rendering.predict_mpi_coarse_to_fine
+        fine_rows=...), so per-example encode-once metrics match the fused
+        batch bit-for-bit in the sampling and to float tolerance overall.
+        Returns (mpi_list, disparity_all) — both cacheable per src image.
+        """
+        def predictor(img, disp):
+            return self.model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                img, disp, train=False)
+
+        H, W = src_img.shape[1:3]
+        grid = geometry.cached_pixel_grid(H, W)
+        xyz_coarse = geometry.plane_xyz_src(
+            grid, disparity, geometry.inverse_intrinsics(K_src))
+        return rendering.predict_mpi_coarse_to_fine(
+            predictor, fine_key, src_img, xyz_coarse, disparity,
+            self.cfg.num_bins_fine, self.cfg.is_bg_depth_inf,
+            fine_rows=(batch_size, row))
 
     def _eval_losses_impl(self, state: TrainState, mpi_list, disparity_all,
                           batch, example_weight=None):
@@ -409,6 +438,16 @@ class SynthesisTrainer:
         """[B,H,W,3] src + [B,S] disparity -> 4-scale MPI pyramid (list of
         [B,S,4,h,w]); the cacheable half of the encode-once eval path."""
         return self._eval_encode(state, src_img, disparity)
+
+    def eval_encode_c2f(self, state: TrainState, src_img, disparity,
+                        fine_key, row, K_src, batch_size: int):
+        """Coarse-to-fine encode of eval-batch row `row` (1-example inputs;
+        `batch_size` is the FULL fused batch size, static). Returns
+        (mpi_list, disparity_all) matching the fused eval step's fine-plane
+        RNG for that row — the encode-once path for num_bins_fine > 0."""
+        return self._eval_encode_c2f(state, src_img, disparity, fine_key,
+                                     jnp.asarray(row, jnp.int32), K_src,
+                                     batch_size=batch_size)
 
     def eval_losses(self, state: TrainState, mpi_list, disparity_all, batch):
         return self._eval_losses(state, mpi_list, disparity_all, batch)

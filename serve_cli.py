@@ -63,7 +63,7 @@ def main():
     from mine_tpu.infer.video import (WARP_BAND, VideoGenerator,
                                       generate_trajectories)
     from mine_tpu.kernels import on_tpu_backend
-    from mine_tpu.serve import MPICache, RenderEngine
+    from mine_tpu.serve import MPICache, RenderEngine, ServeFleet
     from mine_tpu.train.step import SynthesisTrainer
     from mine_tpu.utils import make_logger
 
@@ -105,16 +105,31 @@ def main():
 
     # ONE engine + cache for the whole run: every VideoGenerator below
     # deposits its encode here, trajectories render through the same
-    # compile-once bucketed program (mine_tpu/serve/engine.py)
+    # compile-once bucketed program (mine_tpu/serve/engine.py). A fleet
+    # config (serve.mesh_* > 1 or serve.cache_shards > 1) builds the
+    # ServeFleet instead — mesh render program + key-range-sharded cache
+    # (mine_tpu/serve/fleet.py); the video path renders synchronously, so
+    # the fleet's scheduler thread is left unstarted.
     backend = "pallas" if on_tpu_backend() else "xla"
-    engine = RenderEngine(
+    engine_kw = dict(
         use_alpha=bool(config.get("mpi.use_alpha", False)),
         is_bg_depth_inf=bool(config.get("mpi.is_bg_depth_inf", False)),
         backend=backend,
-        warp_band=WARP_BAND,
-        max_bucket=serve_cfg.max_bucket,
-        cache=MPICache(capacity_bytes=serve_cfg.cache_bytes,
-                       quant=serve_cfg.cache_quant))
+        warp_band=WARP_BAND)
+    fleet = None
+    if (serve_cfg.mesh_batch * serve_cfg.mesh_model > 1
+            or serve_cfg.cache_shards > 1):
+        fleet = ServeFleet.from_config(serve_cfg, start=False, **engine_kw)
+        engine = fleet.engine
+        logger.info("serving fleet: mesh=%dx%d cache_shards=%d scheduler=%s",
+                    serve_cfg.mesh_batch, serve_cfg.mesh_model,
+                    serve_cfg.cache_shards, serve_cfg.scheduler)
+    else:
+        engine = RenderEngine(
+            max_bucket=serve_cfg.max_bucket,
+            cache=MPICache(capacity_bytes=serve_cfg.cache_bytes,
+                           quant=serve_cfg.cache_quant),
+            **engine_kw)
 
     paths = _image_paths(args.data_path)
     if not paths:
@@ -145,6 +160,14 @@ def main():
                 stats["entries"], stats["nbytes"], stats["hits"],
                 stats["misses"], stats["evictions"], stats["quant"],
                 engine.device_calls, engine.sync_encodes)
+    if fleet is not None:
+        fs = fleet.stats()
+        logger.info("fleet stats: mesh=%s shards=%d owner_hits=%d "
+                    "remote_routes=%d owner_encodes=%d rebalances=%d",
+                    fs["mesh"], fs["shards"], fs["owner_hits"],
+                    fs["remote_routes"], fs["owner_encodes"],
+                    fs["rebalances"])
+        fleet.close()
     logger.info("rendered %d views from %d images in %.2fs (%.2f views/s)",
                 views, len(paths), dt, views / max(dt, 1e-9))
     telemetry.emit("serve.stats", views=views, images=len(paths),

@@ -66,6 +66,7 @@ QUICK = {
     "test_rendering.py::test_alpha_composition_two_planes",
     "test_sampling.py::test_stratified_linspace_bins",
     "test_serve.py::test_lru_eviction_order_under_byte_budget",
+    "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_train.py::test_multistep_lr_schedule",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
@@ -104,6 +105,9 @@ MEDIUM_FILES = {
     # video path): what a reviewer most wants re-run after touching warp or
     # compositing (~30 s of the tier's budget)
     "test_serve.py",
+    # the fleet layer on top of it (mesh render bitwise parity, key-range
+    # cache sharding, continuous batching): ~20 s, same reviewer concern
+    "test_serve_fleet.py",
     # the telemetry layer's contracts (histogram math, event schema, the
     # frozen st1 step line, bitwise-unchanged instrumented paths): cheap
     # (~25 s) and every other subsystem now routes through it

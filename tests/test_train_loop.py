@@ -104,15 +104,14 @@ def test_eval_step_masked_padding_invariant():
     assert abs(m_full["loss"] - m_garbage["loss"]) > 1e-9
 
 
-@pytest.mark.slow
-def test_run_eval_encode_once_metric_parity(tmp_path):
-    """serve.eval_encode_once (encode each distinct src ONCE, replay the
-    cached pyramid for every pair) must reproduce the fused eval path's
-    metrics. Parity is np.allclose, not bitwise: the cached path encodes
-    each image at B=1 and batches losses afterward, so conv reductions
-    associate differently in the low-order bits."""
+def _encode_once_parity(tmp_path, **overrides):
+    """Fused eval vs serve.eval_encode_once metrics on a distinct-source
+    val set; parity is np.allclose rtol=1e-4, not bitwise: the cached path
+    encodes each image at B=1 and batches losses afterward, so conv
+    reductions associate differently in the low-order bits."""
     cfg = tiny_config()
     cfg["data.per_gpu_batch_size"] = 2
+    cfg.update(overrides)
     data = SyntheticLoaderAdapter(num_views=6)  # batches 2,2 + masked tail
     state = SynthesisTrainer(cfg, steps_per_epoch=5).init_state(batch_size=2)
 
@@ -133,6 +132,32 @@ def test_run_eval_encode_once_metric_parity(tmp_path):
     for k in fused:
         np.testing.assert_allclose(cached[k], fused[k], rtol=1e-4,
                                    err_msg=k)
+
+
+@pytest.mark.slow
+def test_run_eval_encode_once_metric_parity(tmp_path):
+    """serve.eval_encode_once (encode each distinct src ONCE, replay the
+    cached pyramid for every pair) must reproduce the fused eval path's
+    metrics."""
+    _encode_once_parity(tmp_path)
+
+
+@pytest.mark.slow
+def test_run_eval_encode_once_parity_coarse_to_fine(tmp_path):
+    """Gate lift (PR-7): num_bins_fine > 0 no longer disables encode-once —
+    eval_encode_c2f replays the fused step's per-row fine-plane draws
+    (full-batch uniforms sliced per example, ops/rendering.py fine_rows),
+    so metric parity must hold with coarse-to-fine on."""
+    _encode_once_parity(tmp_path, **{"mpi.num_bins_fine": 4})
+
+
+@pytest.mark.slow
+def test_run_eval_encode_once_parity_on_mesh(tmp_path):
+    """Gate lift (PR-7): a single-host mesh > 1 no longer disables
+    encode-once — the plain-jit eval halves let GSPMD reshard the
+    batch-sharded state on the fly, and metrics must still match the
+    fused (mesh-sharded) eval step."""
+    _encode_once_parity(tmp_path, **{"parallel.data_parallel": 2})
 
 
 @pytest.mark.slow

@@ -11,6 +11,7 @@ Consumes the two parseable streams the telemetry layer emits:
 
 and prints: event counts by kind, span wall-clock stats (count/mean/p50/
 p90/p99 per span path), step-time aggregates, serve bucket-compile history,
+serving-fleet cache placements/rebalances (serve.shard.* events),
 profiler trace windows, and the final metrics snapshot if one was emitted.
 
 Usage:
@@ -98,6 +99,24 @@ def report(events, log_lines):
                        % (e.get("entries_bucket"), e.get("poses_bucket"),
                           e.get("warp_impl"), e.get("dtype"),
                           float(e.get("compile_ms", 0.0))))
+
+    places = [e for e in events if e.get("kind") == "serve.shard.place"]
+    rebalances = [e for e in events
+                  if e.get("kind") == "serve.shard.rebalance"]
+    if places or rebalances:
+        out.append("")
+        out.append("serving fleet (key-range cache sharding):")
+        if places:
+            by_shard = TallyCounter(e.get("shard") for e in places)
+            shards = places[-1].get("shards")
+            out.append("  placements: %d across %s shard(s)"
+                       % (len(places), shards))
+            for shard in sorted(by_shard, key=lambda s: (s is None, s)):
+                out.append("    shard %-4s %7d" % (shard, by_shard[shard]))
+        for e in rebalances:
+            out.append("  rebalance: %s -> %s shards, moved %s of %s entries"
+                       % (e.get("from_shards"), e.get("to_shards"),
+                          e.get("moved"), e.get("entries")))
 
     windows = [e for e in events if e.get("kind") == "profile.window"]
     for e in windows:

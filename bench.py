@@ -736,11 +736,23 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
     per fleet size through a MeshRenderEngine, printing
     "serve_slo[mesh=N] curve/knee" lines (mesh=N also lands in the
     slo_point events); fleet sizes exceeding the device count are skipped
-    loudly. The JSON ips stays the legacy single-device knee."""
+    loudly. The JSON ips stays the legacy single-device knee.
+
+    Trace-sampled mode: MINE_TPU_BENCH_TRACE_SAMPLE=<rate in (0,1]> turns
+    on request tracing (telemetry/tracing.py) for the sweep — every
+    sampled request emits its trace.span tree into the event stream, and
+    each rate point prints a per-span mean breakdown (queue/pad/render) so
+    a latency knee decomposes into WHERE the time went, not just how much."""
     import jax
     import numpy as np
 
     from mine_tpu.serve.batcher import MicroBatcher
+    from mine_tpu.telemetry import tracing
+
+    trace_sample = float(
+        os.environ.get("MINE_TPU_BENCH_TRACE_SAMPLE", "0") or 0)
+    if trace_sample > 0:
+        tracing.configure(sample=trace_sample, recent_capacity=4096)
 
     trainer, state, batch = build_variant_program(name)
     max_bucket = 8
@@ -800,6 +812,22 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
                            p99_ms=round(float(p99), 3),
                            achieved_qps=round(achieved, 3), n_requests=n_req,
                            mesh=chips)
+            if trace_sample > 0:
+                # the batcher head-sampled its own traces (MicroBatcher
+                # auto_trace); this point's are the freshest n_req
+                traces = [t for t in tracing.recent(n_req)
+                          if t["name"] == "serve.request"]
+                by_span = {}
+                for t in traces:
+                    for s in t["spans"]:
+                        if s["parent"] is not None:
+                            by_span.setdefault(s["name"], []).append(s["ms"])
+                breakdown = " ".join(
+                    "%s=%.1f" % (k, sum(v) / len(v))
+                    for k, v in sorted(by_span.items()))
+                print("  %s traces@%.2fqps: n=%d %s (mean ms/span)"
+                      % (tag, offered, len(traces), breakdown),
+                      file=sys.stderr)
 
         print("  %s curve: " % tag
               + " ".join("%.2f:%.1f:%.1f:%.2f" % pt for pt in curve)

@@ -237,6 +237,16 @@ class ServeConfig:
     # serve.eval_cache_quant: quantization of the eval-loop encode cache;
     # float32 (default) keeps metric parity with the per-pair path exact
     eval_cache_quant: str = "float32"
+    # serve.ops_port: opt-in HTTP ops endpoint (/metrics /healthz /slo
+    # /traces/recent; telemetry/export.py) on 127.0.0.1:<port>; 0 = off
+    ops_port: int = 0
+    # serve.slo_objective_ms / slo_target / slo_window_s: rolling-window
+    # SLO tracking (telemetry/slo.py) — breach when the window's p99
+    # exceeds the objective; objective 0 disables breach detection while
+    # the window percentiles keep flowing to /slo and the gauges
+    slo_objective_ms: float = 0.0
+    slo_target: float = 0.99
+    slo_window_s: float = 60.0
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -253,6 +263,10 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         scheduler=str(g("serve.scheduler", "continuous")),
         eval_encode_once=bool(g("serve.eval_encode_once", False)),
         eval_cache_quant=str(g("serve.eval_cache_quant", "float32")),
+        ops_port=int(g("serve.ops_port", 0) or 0),
+        slo_objective_ms=float(g("serve.slo_objective_ms", 0.0) or 0.0),
+        slo_target=float(g("serve.slo_target", 0.99)),
+        slo_window_s=float(g("serve.slo_window_s", 60.0)),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -287,6 +301,19 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.scheduler must be continuous|micro, "
             f"got {out.scheduler!r}")
+    if not 0 <= out.ops_port <= 65535:
+        raise ValueError(
+            f"serve.ops_port must be in [0, 65535], got {out.ops_port}")
+    if out.slo_objective_ms < 0:
+        raise ValueError(
+            f"serve.slo_objective_ms must be >= 0, "
+            f"got {out.slo_objective_ms}")
+    if not 0.0 < out.slo_target < 1.0:
+        raise ValueError(
+            f"serve.slo_target must be in (0, 1), got {out.slo_target}")
+    if out.slo_window_s <= 0:
+        raise ValueError(
+            f"serve.slo_window_s must be > 0, got {out.slo_window_s}")
     return out
 
 
@@ -309,6 +336,10 @@ class TelemetryConfig:
     profile_steps: tuple = ()
     # telemetry.profile_dir: trace destination; "" -> <workspace>/profile
     profile_dir: str = ""
+    # telemetry.trace_sample: request-trace head-sampling rate in [0, 1]
+    # (telemetry/tracing.py); 0 disables tracing, 1 traces every request.
+    # Sampling gates TRACES only — metrics/SLO see every request.
+    trace_sample: float = 0.0
 
 
 def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
@@ -323,6 +354,7 @@ def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
         events_path=str(g("telemetry.events_path", "") or ""),
         profile_steps=tuple(int(s) for s in steps),
         profile_dir=str(g("telemetry.profile_dir", "") or ""),
+        trace_sample=float(g("telemetry.trace_sample", 0.0) or 0.0),
     )
     if out.profile_steps and (
             len(out.profile_steps) != 2 or out.profile_steps[0] < 1
@@ -330,6 +362,10 @@ def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
         raise ValueError(
             "telemetry.profile_steps must be [start, stop] with "
             f"1 <= start <= stop, got {list(out.profile_steps)}")
+    if not 0.0 <= out.trace_sample <= 1.0:
+        raise ValueError(
+            f"telemetry.trace_sample must be in [0, 1], "
+            f"got {out.trace_sample}")
     return out
 
 

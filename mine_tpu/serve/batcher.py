@@ -10,10 +10,20 @@ mapping) and flushes one `RenderEngine.render_many` call of at most
 Thread model: callers `submit` from any thread; a single daemon flush thread
 owns the device dispatch, so the engine's jitted call never races. Tests
 drive `flush()` directly with `start=False` (no timing dependence).
+
+Observability: a request carrying a TraceContext (telemetry/tracing.py —
+attached by `ServeFleet.submit`, or started here when sampling is on) rides
+the pending tuple across the thread handoff; the flush path records its
+"queue" span (enqueue -> dispatch, tagged with which trigger released the
+batch: a full bucket or the deadline), hands the trace to the engine for
+pad/render/encode spans, and seals the trace when the future resolves.
+An attached `slo` tracker (telemetry/slo.py) sees EVERY request's
+end-to-end latency — SLO accounting is never sampled.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import Future
@@ -22,24 +32,38 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from mine_tpu import telemetry
-from mine_tpu.serve.engine import RenderEngine
+from mine_tpu.serve.engine import RenderEngine, pow2_bucket
+from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry.slo import SLOTracker
+
+_log = logging.getLogger(__name__)
 
 
 class MicroBatcher:
     def __init__(self, engine: RenderEngine,
                  max_requests: int = 8,
                  max_wait_ms: float = 2.0,
-                 start: bool = True):
+                 start: bool = True,
+                 slo: Optional[SLOTracker] = None,
+                 auto_trace: bool = True):
         if max_requests < 1:
             raise ValueError(f"max_requests must be >= 1, got {max_requests}")
         self.engine = engine
         self.max_requests = int(max_requests)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.flushes = 0
+        self.slo = slo
+        # the fleet's submit makes the sampling decision (its trace carries
+        # the route span) and passes the result down — auto_trace=False
+        # there keeps this layer from re-rolling the dice on requests the
+        # fleet already declined to sample
+        self.auto_trace = auto_trace
         self._cv = threading.Condition()
-        # (image_id, pose, future, enqueue perf_counter) — the timestamp
-        # feeds the serve.batcher.queue_wait_ms histogram at flush
-        self._pending: List[Tuple[str, np.ndarray, Future, float]] = []
+        # (image_id, pose, future, enqueue perf_counter, trace-or-None) —
+        # the timestamp feeds the serve.batcher.queue_wait_ms histogram at
+        # flush; the trace rides here across the submit->flush thread hop
+        self._pending: List[Tuple[str, np.ndarray, Future, float,
+                                  Optional[tracing.TraceContext]]] = []
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -47,16 +71,23 @@ class MicroBatcher:
                                             name="mine-tpu-serve-batcher")
             self._thread.start()
 
-    def submit(self, image_id: str, pose_44: np.ndarray) -> Future:
+    def submit(self, image_id: str, pose_44: np.ndarray,
+               trace: Optional[tracing.TraceContext] = None) -> Future:
         """Enqueue one view request; resolves to (rgb [3,H,W],
-        depth [1,H,W]) f32 numpy."""
+        depth [1,H,W]) f32 numpy. `trace` attaches an already-started
+        request trace (the fleet's submit passes one that already carries
+        the route span); without one, the batcher makes its own sampling
+        decision (unless auto_trace is off) so a bare-batcher deployment
+        still gets traces."""
+        if trace is None and self.auto_trace:
+            trace = tracing.start("serve.request", image_id=str(image_id)[:12])
         fut: Future = Future()
         with self._cv:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append(
                 (image_id, np.asarray(pose_44, np.float32), fut,
-                 time.perf_counter()))
+                 time.perf_counter(), trace))
             self._cv.notify()
         return fut
 
@@ -69,22 +100,33 @@ class MicroBatcher:
         if not batch:
             return 0
         now = time.perf_counter()
+        cause = "full" if len(batch) >= self.max_requests else "deadline"
         wait_hist = telemetry.histogram("serve.batcher.queue_wait_ms")
-        for _, _, _, t_enq in batch:
+        for _, _, _, t_enq, trace in batch:
             wait_hist.record((now - t_enq) * 1e3)
+            if trace is not None:
+                trace.add_span("queue", (now - t_enq) * 1e3, t0=t_enq,
+                               flush_cause=cause, batch_size=len(batch))
         telemetry.histogram(
             "serve.batcher.coalesce_size",
             edges=telemetry.pow2_buckets(1024)).record(len(batch))
         try:
             results = self.engine.render_many(
-                [(i, p) for i, p, _, _ in batch])
+                [(i, p) for i, p, _, _, _ in batch],
+                traces=[t for _, _, _, _, t in batch])
             self.flushes += 1
-            for (_, _, fut, _), res in zip(batch, results):
+            done = time.perf_counter()
+            bucket = pow2_bucket(len(batch))
+            for (_, _, fut, t_enq, trace), res in zip(batch, results):
                 fut.set_result(res)
+                if self.slo is not None:
+                    self.slo.record((done - t_enq) * 1e3, bucket=bucket)
+                tracing.finish(trace)
         except Exception as e:  # pragma: no cover - device failures
-            for _, _, fut, _ in batch:
+            for _, _, fut, _, trace in batch:
                 if not fut.done():
                     fut.set_exception(e)
+                tracing.finish(trace, ok=False)
         return len(batch)
 
     def _run(self) -> None:
@@ -102,15 +144,32 @@ class MicroBatcher:
                     self._cv.wait(timeout=self.max_wait_s)
             self.flush()
 
-    def close(self) -> None:
-        """Drain pending requests, then stop the flush thread."""
+    def close(self, timeout: float = 10.0) -> bool:
+        """Drain pending requests and stop + JOIN the flush thread; returns
+        True once the thread is confirmed dead. The join is bounded: a
+        thread wedged in a device call can't hang the caller's exit — but a
+        failed join is LOUD (a warning), never silent, because a dangling
+        daemon thread racing interpreter teardown is exactly the flaky-exit
+        bug this method exists to prevent."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+        # drain on the caller's thread whatever the flush thread left
+        # behind (it exits as soon as it sees _closed with an empty queue)
         while self.flush():
             pass
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+        if thread is not None and thread.is_alive():
+            _log.warning(
+                "batcher flush thread failed to join within %.1fs; "
+                "it remains daemon and will die with the process", timeout)
+            return False
+        self._thread = None
+        return True
 
 
 class ContinuousBatcher(MicroBatcher):
@@ -129,8 +188,10 @@ class ContinuousBatcher(MicroBatcher):
 
     Same queue-wait / coalesce-size histograms as MicroBatcher (the flush
     path is inherited); `serve.batcher.flush_full` / `flush_deadline`
-    count which trigger fired. Tests drive `_ready` and `flush()` directly
-    with start=False (no timing dependence).
+    count which trigger fired — the same full-vs-deadline verdict each
+    request's "queue" trace span carries as `flush_cause`. Tests drive
+    `_ready` and `flush()` directly with start=False (no timing
+    dependence); `close()` joins the deadline loop like the base class.
     """
 
     def flush(self) -> int:

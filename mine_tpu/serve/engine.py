@@ -140,7 +140,7 @@ class RenderEngine:
             self.cache.put(image_id, *self.encode_fn(img_hwc))
         return image_id
 
-    def _entry(self, image_id: str, image=None) -> MPIEntry:
+    def _entry(self, image_id: str, image=None, traces=()) -> MPIEntry:
         entry = self.cache.get(image_id)
         if entry is not None:
             return entry
@@ -151,9 +151,17 @@ class RenderEngine:
         _warn_sync_encode(id(self), image_id)
         self.sync_encodes += 1
         telemetry.counter("serve.sync_encode").inc()
+        t0 = time.perf_counter()
         # emit=False: the span event would duplicate this richer one
         with telemetry.span("serve.sync_encode", emit=False):
             entry = self.cache.put(image_id, *self.encode_fn(image))
+        encode_ms = (time.perf_counter() - t0) * 1e3
+        # every traced request waiting on this entry pays the encode: the
+        # span lands in each of their traces, not just the one that missed
+        for trace in traces:
+            if trace is not None:
+                trace.add_span("encode", encode_ms, t0=t0,
+                               image_id=image_id[:12], sync=True)
         telemetry.emit("serve.sync_encode", image_id=image_id[:12],
                        total=self.sync_encodes)
         return entry
@@ -192,8 +200,15 @@ class RenderEngine:
         its NamedSharding so the jitted program spans the serving mesh."""
         return planes, scales, disp, K, K_inv, idx, poses
 
+    def _render_span_fields(self) -> dict:
+        """Extra fields for a request trace's "render" span; the mesh
+        subclass adds its mesh shape so a waterfall shows which fleet
+        topology rendered the request."""
+        return {}
+
     def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
-              poses: np.ndarray, warp_impl: Optional[str]):
+              poses: np.ndarray, warp_impl: Optional[str],
+              traces: Optional[Sequence] = None):
         """Bucket R and P, pad, dispatch ONE device call, slice."""
         t0 = time.perf_counter()
         warp_impl = warp_impl or self.warp_impl
@@ -222,12 +237,15 @@ class RenderEngine:
         args = self._place(planes, scales, disp, K, K_inv,
                            jnp.asarray(idx, jnp.int32),
                            jnp.asarray(poses, jnp.float32))
+        t_dispatch = time.perf_counter()
         rgb, depth = self._render(*args, warp_impl)
         self.device_calls += 1
         out = np.asarray(rgb[:P]), np.asarray(depth[:P])  # device sync
-        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        t_end = time.perf_counter()
+        elapsed_ms = (t_end - t0) * 1e3
         bucket = (Rb, Pb, warp_impl, str(planes.dtype))
-        if bucket not in self._seen_buckets:
+        compiled = bucket not in self._seen_buckets
+        if compiled:
             # first dispatch of this (shape-bucket, impl, dtype) key: jit
             # traced + compiled a new executable, so this call's time is
             # compile-dominated — recorded as a compile event, NOT into
@@ -240,43 +258,74 @@ class RenderEngine:
                            compile_ms=round(elapsed_ms, 3))
         else:
             telemetry.histogram("serve.render_call_ms").record(elapsed_ms)
+        if traces:
+            # two host-side spans per traced rider: the stack/pad/place
+            # work before dispatch, then the device call itself (dispatch
+            # to output sync — compile-dominated on a cold bucket, which
+            # the compiled flag marks so waterfalls aren't misread)
+            extra = self._render_span_fields()
+            pad_ms = (t_dispatch - t0) * 1e3
+            render_ms = (t_end - t_dispatch) * 1e3
+            for trace in traces:
+                if trace is None:
+                    continue
+                trace.add_span("pad", pad_ms, t0=t0, entries_bucket=Rb,
+                               poses_bucket=Pb, padded_poses=Pb - P)
+                trace.add_span("render", render_ms, t0=t_dispatch,
+                               warp_impl=warp_impl, compiled=compiled,
+                               **extra)
         return out
 
     # ---------------- public render paths ----------------
 
     def render(self, image_id: str, poses_P44: np.ndarray,
                warp_impl: Optional[str] = None,
-               image=None) -> Tuple[np.ndarray, np.ndarray]:
+               image=None, trace=None) -> Tuple[np.ndarray, np.ndarray]:
         """All P poses against ONE cached MPI -> (rgb [P,3,H,W],
         depth [P,1,H,W]) f32 numpy. Full max_bucket chunks, then one
-        pow2-bucketed remainder call."""
-        entry = self._entry(image_id, image=image)
+        pow2-bucketed remainder call. `trace` attaches a request trace
+        (telemetry/tracing.py): every chunk's pad/render spans — and a
+        sync encode, if this call pays one — land in it."""
+        chunk_traces = [trace] if trace is not None else None
+        entry = self._entry(image_id, image=image,
+                            traces=chunk_traces or ())
         poses = np.asarray(poses_P44, np.float32)
         P = poses.shape[0]
         rgbs, depths = [], []
         for i in range(0, P, self.max_bucket):
             chunk = poses[i:i + self.max_bucket]
             rgb, depth = self._call(
-                [entry], np.zeros(chunk.shape[0], np.int32), chunk, warp_impl)
+                [entry], np.zeros(chunk.shape[0], np.int32), chunk,
+                warp_impl, traces=chunk_traces)
             rgbs.append(rgb)
             depths.append(depth)
         return np.concatenate(rgbs), np.concatenate(depths)
 
     def render_many(self, requests: Sequence[Tuple[str, np.ndarray]],
-                    warp_impl: Optional[str] = None
+                    warp_impl: Optional[str] = None,
+                    traces: Optional[Sequence] = None
                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Coalesced path: [(image_id, pose [4,4])...] across DISTINCT
-        cached MPIs -> one device call; per-request (rgb, depth) in order."""
+        cached MPIs -> one device call; per-request (rgb, depth) in order.
+        `traces` aligns with `requests` (None entries fine): each traced
+        request gets this dispatch's pad/render spans."""
         if not requests:
             return []
+        if traces is None:
+            traces = [None] * len(requests)
         order: List[str] = []
         for image_id, _ in requests:
             if image_id not in order:
                 order.append(image_id)
-        entries = [self._entry(i) for i in order]
+        entries = [
+            self._entry(i, traces=[t for (rid, _), t
+                                   in zip(requests, traces)
+                                   if t is not None and rid == i])
+            for i in order]
         idx = np.asarray([order.index(i) for i, _ in requests], np.int32)
         poses = np.stack([np.asarray(p, np.float32) for _, p in requests])
-        rgb, depth = self._call(entries, idx, poses, warp_impl)
+        rgb, depth = self._call(entries, idx, poses, warp_impl,
+                                traces=[t for t in traces if t is not None])
         return [(rgb[j], depth[j]) for j in range(len(requests))]
 
     def warmup(self, image_id: str,

@@ -28,12 +28,16 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import time
 from typing import Callable, List, Optional
 
 from mine_tpu import telemetry
 from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import MPICache, MPIEntry
 from mine_tpu.serve.shardmap import MeshRenderEngine
+from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry.export import OpsServer
+from mine_tpu.telemetry.slo import SLOTracker
 
 _METRIC_PREFIX = "serve.shard"
 # ownership uses the leading 32 bits of the content hash: wide enough that
@@ -198,6 +202,11 @@ class ServeFleet:
                  encode_fn: Optional[Callable] = None,
                  start: bool = True,
                  devices=None,
+                 trace_sample: Optional[float] = None,
+                 slo_objective_ms: float = 0.0,
+                 slo_target: float = 0.99,
+                 slo_window_s: float = 60.0,
+                 ops_port: Optional[int] = None,
                  **engine_kw):
         self.cache = ShardedPlaneCache(
             num_shards=cache_shards, capacity_bytes=cache_bytes,
@@ -209,16 +218,30 @@ class ServeFleet:
         if scheduler not in ("continuous", "micro"):
             raise ValueError(
                 f"serve.scheduler must be continuous|micro, got {scheduler!r}")
+        # trace_sample None = defer to the process-wide tracing.configure
+        # rate; a number pins this fleet's own head-sampling rate
+        self.trace_sample = trace_sample
+        # the SLO tracker sees EVERY request (recording is cheap; sampling
+        # is for traces) — the batcher's flush path feeds it
+        self.slo = SLOTracker(objective_ms=slo_objective_ms,
+                              target=slo_target, window_s=slo_window_s)
         batcher_cls = ContinuousBatcher if scheduler == "continuous" \
             else MicroBatcher
         self.batcher = batcher_cls(self.engine, max_requests=max_requests,
-                                   max_wait_ms=max_wait_ms, start=start)
+                                   max_wait_ms=max_wait_ms, start=start,
+                                   slo=self.slo, auto_trace=False)
         self._front = itertools.count()
+        # opt-in live ops plane; port 0 binds ephemeral (tests), None = off
+        self.ops: Optional[OpsServer] = None
+        if ops_port is not None:
+            self.ops = OpsServer(port=ops_port, slo=self.slo).start()
 
     @classmethod
     def from_config(cls, serve_cfg, encode_fn=None, start: bool = True,
                     devices=None, **engine_kw) -> "ServeFleet":
-        """Build from a config.ServeConfig (the serve.* key block)."""
+        """Build from a config.ServeConfig (the serve.* key block).
+        serve.ops_port 0 means "no endpoint" at the config surface (the
+        ephemeral-port niche is a test concern, not a YAML one)."""
         return cls(mesh_batch=serve_cfg.mesh_batch,
                    mesh_model=serve_cfg.mesh_model,
                    cache_shards=serve_cfg.cache_shards,
@@ -228,6 +251,11 @@ class ServeFleet:
                    max_requests=serve_cfg.max_requests,
                    max_wait_ms=serve_cfg.max_wait_ms,
                    max_bucket=serve_cfg.max_bucket,
+                   slo_objective_ms=serve_cfg.slo_objective_ms,
+                   slo_target=serve_cfg.slo_target,
+                   slo_window_s=serve_cfg.slo_window_s,
+                   ops_port=serve_cfg.ops_port if serve_cfg.ops_port > 0
+                   else None,
                    encode_fn=encode_fn, start=start, devices=devices,
                    **engine_kw)
 
@@ -237,10 +265,21 @@ class ServeFleet:
     def submit(self, image_id: str, pose_44):
         """One view request through the fleet: round-robin front-end shard,
         owner routing (telemetry), scheduler coalescing. Resolves to
-        (rgb [3,H,W], depth [1,H,W]) f32 numpy."""
+        (rgb [3,H,W], depth [1,H,W]) f32 numpy.
+
+        A sampled request's trace is born HERE — the route decision is its
+        first child span (front shard, owner shard, remote hop or not) and
+        the context then rides the batcher's queue into the flush thread."""
         caller = next(self._front) % self.cache.num_shards
-        self.cache.route(caller, image_id)
-        return self.batcher.submit(image_id, pose_44)
+        trace = tracing.start("serve.request", sample=self.trace_sample,
+                              image_id=str(image_id)[:12])
+        t0 = time.perf_counter()
+        owner = self.cache.route(caller, image_id)
+        if trace is not None:
+            trace.add_span("route", (time.perf_counter() - t0) * 1e3, t0=t0,
+                           front_shard=caller, owner_shard=owner,
+                           remote=caller != owner)
+        return self.batcher.submit(image_id, pose_44, trace=trace)
 
     def render(self, image_id: str, poses_P44, **kw):
         return self.engine.render(image_id, poses_P44, **kw)
@@ -259,8 +298,12 @@ class ServeFleet:
         s.update(device_calls=self.engine.device_calls,
                  sync_encodes=self.engine.sync_encodes,
                  flushes=self.batcher.flushes,
+                 slo_breaches=self.slo.breaches,
                  mesh=f"{self.engine.mesh_batch}x{self.engine.mesh_model}")
         return s
 
     def close(self) -> None:
         self.batcher.close()
+        if self.ops is not None:
+            self.ops.close()
+            self.ops = None

@@ -115,6 +115,12 @@ class MeshRenderEngine(RenderEngine):
     def num_devices(self) -> int:
         return self.mesh.size
 
+    def _render_span_fields(self) -> dict:
+        """Request traces rendered here carry the mesh topology, so a
+        waterfall read offline still knows which fleet shape it measured."""
+        return {"mesh": f"{self.mesh_batch}x{self.mesh_model}",
+                "devices": self.mesh.size}
+
     def _place(self, planes, scales, disp, K, K_inv, idx, poses):
         """Commit every operand under its NamedSharding; the committed
         inputs are what make the jitted program span the mesh."""

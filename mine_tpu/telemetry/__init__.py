@@ -13,6 +13,12 @@ coherent, parseable surface:
                write failure, validated in CI (tools/validate_events.py),
                consumed by tools/obs_report.py
   spans.py     scoped wall-clock timers feeding both of the above
+  tracing.py   request-level traces: per-request span trees carried across
+               threads, emitted as trace.span events (serve path anatomy)
+  slo.py       rolling-window SLO tracker: sliding p50/p99 vs a
+               configurable objective, error-budget burn, breach events
+  export.py    Prometheus text exposition of the registry + the opt-in
+               HTTP ops endpoint (/metrics /healthz /slo /traces/recent)
   stepline.py  the frozen "time: schema=st1 ..." step-time line + its one
                shared parser
   profiler.py  opt-in jax.profiler trace windows over exact train-loop step
@@ -20,27 +26,33 @@ coherent, parseable surface:
 
 Dependency-free (stdlib only) and strictly host-side: nothing in here is
 ever traced, so instrumentation cannot change jitted numerics or add a
-device sync — the bitwise-parity tests in tests/test_telemetry.py hold the
-package to that.
+device sync — the bitwise-parity tests in tests/test_telemetry.py and
+tests/test_serve_trace_e2e.py hold the package to that.
 """
 
-from mine_tpu.telemetry.events import (emit, ensure_configured,
+from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry.events import (KIND_FIELDS, emit, ensure_configured,
                                        validate_file, validate_line)
+from mine_tpu.telemetry.export import (OpsServer, parse_prometheus,
+                                       render_prometheus)
 from mine_tpu.telemetry.profiler import ProfileWindow
 from mine_tpu.telemetry.registry import (REGISTRY, Counter, Gauge, Histogram,
                                          MetricsRegistry, counter,
                                          default_latency_buckets_ms, gauge,
                                          histogram, pow2_buckets)
+from mine_tpu.telemetry.slo import SLOTracker
 from mine_tpu.telemetry.spans import current_span_path, span
 from mine_tpu.telemetry.stepline import (STEP_KEYS, STEP_SCHEMA, TIME_KEYS,
                                          format_step_line, parse_line,
                                          parse_lines)
+from mine_tpu.telemetry.tracing import TraceContext
 
 __all__ = [
-    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "ProfileWindow",
+    "KIND_FIELDS", "OpsServer", "REGISTRY", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "ProfileWindow", "SLOTracker", "TraceContext",
     "STEP_KEYS", "STEP_SCHEMA", "TIME_KEYS", "counter", "current_span_path",
     "default_latency_buckets_ms", "emit", "ensure_configured",
     "format_step_line", "gauge", "histogram", "parse_line", "parse_lines",
-    "pow2_buckets", "span", "validate_file", "validate_line",
+    "parse_prometheus", "pow2_buckets", "render_prometheus", "span",
+    "tracing", "validate_file", "validate_line",
 ]

@@ -180,10 +180,34 @@ def reset() -> None:
 
 # ---------------------------------------------------------------- validation
 
-def validate_line(line: str) -> Optional[str]:
+# Required payload fields per DOCUMENTED kind (MIGRATION.md holds the full
+# schemas). mtpu-ev1 evolution is append-only: emitters may ADD fields to a
+# kind, never remove or rename one listed here — `--strict` validation
+# (tools/validate_events.py) is the drift tripwire. Kinds absent from this
+# table pass strict mode on the base schema alone (new kinds are free to
+# appear; they become pinned once documented here).
+KIND_FIELDS: Dict[str, tuple] = {
+    "train.step": ("gstep", "step_ms"),
+    "span": ("name", "ms"),
+    "trace.span": ("trace", "span", "name", "ms", "t_off_ms"),
+    "serve.sync_encode": ("image_id",),
+    "serve.bucket_compile": ("entries_bucket", "poses_bucket", "warp_impl",
+                             "dtype", "compile_ms"),
+    "serve.slo_point": ("offered_qps", "achieved_qps", "p50_ms", "p99_ms"),
+    "serve.slo_breach": ("p99_ms", "objective_ms", "window_s"),
+    "serve.shard.place": ("image_id", "shard", "shards"),
+    "serve.shard.rebalance": ("from_shards", "to_shards", "moved"),
+    "metrics.snapshot": ("scope", "metrics"),
+    "profile.window": ("start_step", "stop_step", "trace_dir"),
+}
+
+
+def validate_line(line: str, strict_kinds: bool = False) -> Optional[str]:
     """Schema check of one JSONL line; None when valid, else a short error
     string. Blank lines are valid (a crashed writer's trailing newline must
-    not fail CI). Shared by tools/validate_events.py and obs_report."""
+    not fail CI). Shared by tools/validate_events.py and obs_report.
+    `strict_kinds` additionally requires every documented kind (KIND_FIELDS)
+    to carry its pinned payload fields."""
     s = line.strip()
     if not s:
         return None
@@ -202,15 +226,22 @@ def validate_line(line: str) -> Optional[str]:
         return f"ts must be numeric, got {type(obj['ts']).__name__}"
     if not isinstance(obj["kind"], str) or not obj["kind"]:
         return "kind must be a non-empty string"
+    if strict_kinds:
+        missing = [k for k in KIND_FIELDS.get(obj["kind"], ())
+                   if k not in obj]
+        if missing:
+            return (f"kind {obj['kind']!r} missing documented field(s) "
+                    f"{missing}")
     return None
 
 
-def validate_file(path: str, max_errors: int = 20) -> List[str]:
+def validate_file(path: str, max_errors: int = 20,
+                  strict_kinds: bool = False) -> List[str]:
     """-> list of "line N: error" strings (empty = file is schema-clean)."""
     errors = []
     with open(path) as f:
         for i, line in enumerate(f, 1):
-            err = validate_line(line)
+            err = validate_line(line, strict_kinds=strict_kinds)
             if err is not None:
                 errors.append(f"line {i}: {err}")
                 if len(errors) >= max_errors:

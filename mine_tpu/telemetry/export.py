@@ -1,0 +1,187 @@
+"""Live ops plane: Prometheus text exposition + stdlib HTTP ops endpoint.
+
+`render_prometheus` serializes the metrics registry into the Prometheus
+text exposition format (version 0.0.4) — counters as `<name>_total`,
+gauges verbatim, histograms as the standard cumulative
+`_bucket{le="..."}` / `_sum` / `_count` family — so any off-the-shelf
+scraper can consume the PR-6 registry without this repo growing a client
+dependency. `parse_prometheus` is the matching minimal parser the tests
+round-trip through (it validates the grammar we emit, not the full spec).
+
+`OpsServer` is the opt-in endpoint behind `serve.ops_port`
+(`ThreadingHTTPServer` on a daemon thread, loopback by default):
+
+    /metrics        Prometheus text from the registry
+    /healthz        200 "ok" (liveness)
+    /slo            rolling-window SLO snapshot (telemetry/slo.py), JSON
+    /traces/recent  last completed traces (telemetry/tracing.py), JSON
+
+Port 0 binds an ephemeral port (tests read `.port`). Everything here is
+host-side and stdlib-only; request handling never touches jax state — the
+handlers only READ registry/tracker/ring snapshots, each of which takes
+its own internal locks.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Dict, Optional
+
+from mine_tpu.telemetry import registry as _registry
+from mine_tpu.telemetry import tracing as _tracing
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+# one sample line: name{labels} value   (labels optional; value a float
+# literal, inf/nan included). This is the grammar render_prometheus emits.
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{([a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*)\})?'
+    r' (-?(?:[0-9.e+-]+|[+-]?Inf|NaN))$')
+
+
+def prom_name(name: str, prefix: str = "mtpu_") -> str:
+    """Dotted registry path -> Prometheus metric name: `serve.cache.hits`
+    -> `mtpu_serve_cache_hits`."""
+    return prefix + _NAME_SANITIZE.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    # integral values print without the trailing .0 (Prometheus accepts
+    # either; the compact form diffs cleanly in tests)
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(
+        registry: Optional[_registry.MetricsRegistry] = None) -> str:
+    """Serialize every registered metric; deterministic order (registry
+    names are sorted). Ends with a newline per the format spec."""
+    reg = registry if registry is not None else _registry.REGISTRY
+    lines = []
+    for name in reg.names():
+        m = reg.get(name)
+        if m is None:  # racing a reset(): skip, never crash a scrape
+            continue
+        pn = prom_name(name)
+        if isinstance(m, _registry.Counter):
+            lines.append(f"# TYPE {pn}_total counter")
+            lines.append(f"{pn}_total {_fmt(m.value)}")
+        elif isinstance(m, _registry.Gauge):
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_fmt(m.value)}")
+        elif isinstance(m, _registry.Histogram):
+            edges, counts = m.bucket_counts()
+            lines.append(f"# TYPE {pn} histogram")
+            cum = 0
+            for edge, c in zip(edges, counts):
+                cum += c
+                lines.append(f'{pn}_bucket{{le="{_fmt(edge)}"}} {cum}')
+            cum += counts[-1]  # overflow bucket
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{pn}_sum {_fmt(m.sum)}")
+            lines.append(f"{pn}_count {cum}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Parse text exposition into {'name' or 'name{labels}': value};
+    raises ValueError on any malformed line. Validates what we emit: the
+    tests' proof that /metrics output is scrapable."""
+    out: Dict[str, float] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        mt = _SAMPLE_RE.match(line)
+        if mt is None:
+            raise ValueError(f"line {i}: not a metric sample: {line!r}")
+        name, labels, value = mt.groups()
+        key = f"{name}{{{labels}}}" if labels else name
+        if key in out:
+            raise ValueError(f"line {i}: duplicate sample {key!r}")
+        out[key] = float(value.replace("Inf", "inf").replace("NaN", "nan"))
+    return out
+
+
+class OpsServer:
+    """Opt-in HTTP ops endpoint; see module docstring. Construct bound
+    (but not serving), then `.start()`; `.close()` shuts down and joins."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[_registry.MetricsRegistry] = None,
+                 slo=None, traces_limit: int = 32):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        ops = self
+        self.registry = registry if registry is not None \
+            else _registry.REGISTRY
+        self.slo = slo
+        self.traces_limit = int(traces_limit)
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (stdlib handler API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send(200, b"ok\n", "text/plain")
+                    elif path == "/metrics":
+                        body = render_prometheus(ops.registry)
+                        self._send(200, body.encode(), CONTENT_TYPE)
+                    elif path == "/slo":
+                        snap = ops.slo.snapshot() if ops.slo is not None \
+                            else {}
+                        self._send(200, (json.dumps(snap) + "\n").encode())
+                    elif path == "/traces/recent":
+                        traces = _tracing.recent(ops.traces_limit)
+                        body = json.dumps({"traces": traces}) + "\n"
+                        self._send(200, body.encode())
+                    else:
+                        self._send(404, b'{"error": "not found"}\n')
+                except BrokenPipeError:  # client went away mid-response
+                    pass
+
+            def log_message(self, fmt, *args):  # silence per-request noise
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OpsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mine-tpu-ops-server")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -183,6 +183,14 @@ class Histogram:
                 cum += c
             return self._max
 
+    def bucket_counts(self):
+        """-> (edges, counts): the raw per-bucket counts, counts[i] holding
+        samples <= edges[i] (counts[-1] is the overflow bucket past the last
+        edge). Consistent snapshot under the lock — what the Prometheus
+        exporter (telemetry/export.py) cumulates into `_bucket{le=...}`."""
+        with self._lock:
+            return self.edges, tuple(self._counts)
+
     def percentiles(self) -> Dict[str, float]:
         return {"p50": self.quantile(0.50),
                 "p90": self.quantile(0.90),

@@ -1,0 +1,178 @@
+"""Rolling-window SLO tracker for the serving path.
+
+The registry's histograms are cumulative-forever — right for "how has this
+process done since boot", wrong for "are we in breach RIGHT NOW". This
+tracker keeps the last `window_s` seconds of request latencies in a
+bounded deque, computes exact sliding-window p50/p99 (exact order
+statistics over <= `max_samples` floats, not bucket-interpolated — a
+breach decision should not carry bucket-width error), and compares the
+rolling p99 against a configurable objective:
+
+  * gauges `serve.slo.p50_ms` / `serve.slo.p99_ms` / `serve.slo.window_n`
+    and `serve.slo.error_budget_burn` mirror the window into the registry
+    (so /metrics and metrics.snapshot carry them);
+  * crossing INTO breach emits one `serve.slo_breach` event (edge-
+    triggered: one event per excursion, not one per request while bad);
+  * `snapshot()` returns the JSON the ops endpoint's `/slo` route serves,
+    including per-bucket percentiles (bucket = the dispatch batch's pow2
+    size, so tail latency reads per compiled shape).
+
+Error-budget burn is the standard SRE ratio: with target 0.99, the budget
+is 1% of requests over objective; burn = (observed bad fraction) /
+(1 - target). burn > 1 means the window is eating budget faster than
+allowed.
+
+Host-side, stdlib-only, thread-safe (the batcher's flush thread records
+while the ops endpoint snapshots). `now` is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from mine_tpu.telemetry import events as _events
+from mine_tpu.telemetry import registry as _registry
+
+# below this many samples in the window, p99 is noise — never declare a
+# breach on it (a single slow warmup request must not page anyone)
+MIN_BREACH_SAMPLES = 20
+
+
+def _pct(sorted_vals, q: float) -> float:
+    """Exact order statistic (nearest-rank with linear interpolation)."""
+    if not sorted_vals:
+        return float("nan")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    i = int(pos)
+    frac = pos - i
+    if i + 1 >= len(sorted_vals):
+        return sorted_vals[-1]
+    return sorted_vals[i] + (sorted_vals[i + 1] - sorted_vals[i]) * frac
+
+
+class SLOTracker:
+    """See module docstring. `objective_ms=0` disables breach detection
+    (the tracker still serves rolling percentiles)."""
+
+    def __init__(self, objective_ms: float = 0.0, target: float = 0.99,
+                 window_s: float = 60.0, max_samples: int = 8192,
+                 metric_prefix: str = "serve.slo"):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"slo target must be in (0, 1), got {target}")
+        if window_s <= 0:
+            raise ValueError(f"slo window_s must be > 0, got {window_s}")
+        if objective_ms < 0:
+            raise ValueError(
+                f"slo objective_ms must be >= 0, got {objective_ms}")
+        self.objective_ms = float(objective_ms)
+        self.target = float(target)
+        self.window_s = float(window_s)
+        self.max_samples = int(max_samples)
+        self.metric_prefix = metric_prefix
+        self._lock = threading.Lock()
+        # (t_monotonic, latency_ms, bucket) — bounded twice: by age
+        # (window_s, pruned on every record/snapshot) and by count
+        # (max_samples, the deque's maxlen)
+        self._samples: deque = deque(maxlen=self.max_samples)
+        self._breaching = False
+        self.breaches = 0
+        self.recorded = 0
+
+    # ---------------- internals (callers hold self._lock) ----------------
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def _window_stats(self) -> Dict:
+        vals = sorted(s[1] for s in self._samples)
+        n = len(vals)
+        bad = sum(1 for s in self._samples
+                  if self.objective_ms and s[1] > self.objective_ms)
+        burn = 0.0
+        if self.objective_ms and n:
+            burn = (bad / n) / (1.0 - self.target)
+        return {"n": n, "p50_ms": _pct(vals, 0.50),
+                "p99_ms": _pct(vals, 0.99), "bad": bad, "burn": burn}
+
+    # ---------------- recording ----------------
+
+    def record(self, latency_ms: float, bucket: Optional[int] = None,
+               now: Optional[float] = None) -> None:
+        """Record one request's end-to-end latency. `bucket` tags the
+        dispatch batch's pow2 size (per-shape tail in snapshot())."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            self._samples.append((now, float(latency_ms), bucket))
+            self.recorded += 1
+            self._prune(now)
+            st = self._window_stats()
+            breach_edge = False
+            if (self.objective_ms and st["n"] >= MIN_BREACH_SAMPLES
+                    and st["p99_ms"] > self.objective_ms):
+                if not self._breaching:
+                    self._breaching = True
+                    self.breaches += 1
+                    breach_edge = True
+            elif self._breaching and (not self.objective_ms
+                                      or st["p99_ms"] <= self.objective_ms):
+                self._breaching = False
+        pre = self.metric_prefix
+        _registry.gauge(pre + ".p50_ms").set(st["p50_ms"])
+        _registry.gauge(pre + ".p99_ms").set(st["p99_ms"])
+        _registry.gauge(pre + ".window_n").set(st["n"])
+        _registry.gauge(pre + ".error_budget_burn").set(st["burn"])
+        if breach_edge:
+            _events.emit("serve.slo_breach",
+                         p99_ms=round(st["p99_ms"], 3),
+                         objective_ms=self.objective_ms,
+                         window_s=self.window_s, window_n=st["n"],
+                         target=self.target,
+                         error_budget_burn=round(st["burn"], 4))
+
+    @property
+    def breaching(self) -> bool:
+        with self._lock:
+            return self._breaching
+
+    # ---------------- reporting ----------------
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        """JSON-safe rolling-window view (what /slo serves): overall +
+        per-bucket percentiles, objective, breach state, budget burn."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            st = self._window_stats()
+            per_bucket: Dict = {}
+            for _, ms, bucket in self._samples:
+                per_bucket.setdefault(bucket, []).append(ms)
+            buckets = {}
+            for bucket in sorted(per_bucket,
+                                 key=lambda b: (b is None, b)):
+                vals = sorted(per_bucket[bucket])
+                buckets[str(bucket)] = {
+                    "n": len(vals),
+                    "p50_ms": round(_pct(vals, 0.50), 3),
+                    "p99_ms": round(_pct(vals, 0.99), 3)}
+            breaching = self._breaching
+            breaches = self.breaches
+            recorded = self.recorded
+        out = {"objective_ms": self.objective_ms, "target": self.target,
+               "window_s": self.window_s, "window_n": st["n"],
+               "recorded": recorded, "breaching": breaching,
+               "breaches": breaches,
+               "error_budget_burn": round(st["burn"], 4),
+               "buckets": buckets}
+        for k in ("p50_ms", "p99_ms"):
+            v = st[k]
+            out[k] = round(v, 3) if v == v else None  # NaN -> null (JSON)
+        return out

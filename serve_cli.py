@@ -59,7 +59,8 @@ def main():
 
     from mine_tpu import telemetry
     from mine_tpu.config import (CONFIG_DIR, load_config, postprocess,
-                                 serve_config_from_dict)
+                                 serve_config_from_dict,
+                                 telemetry_config_from_dict)
     from mine_tpu.infer.video import (WARP_BAND, VideoGenerator,
                                       generate_trajectories)
     from mine_tpu.kernels import on_tpu_backend
@@ -85,6 +86,13 @@ def main():
         config = load_config(os.path.join(CONFIG_DIR, "params_llff.yaml"),
                              extra_config=args.extra_config)
     serve_cfg = serve_config_from_dict(config)
+    telem_cfg = telemetry_config_from_dict(config)
+    if telem_cfg.trace_sample > 0:
+        # head-sampled request traces: each sampled request/image emits a
+        # trace.span tree into the event stream (telemetry/tracing.py)
+        telemetry.tracing.configure(sample=telem_cfg.trace_sample)
+        logger.info("request tracing on: sample=%.3g",
+                    telem_cfg.trace_sample)
 
     trainer = SynthesisTrainer(config, steps_per_epoch=1)
     state = trainer.init_state(batch_size=1)
@@ -117,10 +125,13 @@ def main():
         backend=backend,
         warp_band=WARP_BAND)
     fleet = None
+    ops = None
     if (serve_cfg.mesh_batch * serve_cfg.mesh_model > 1
             or serve_cfg.cache_shards > 1):
         fleet = ServeFleet.from_config(serve_cfg, start=False, **engine_kw)
         engine = fleet.engine
+        slo = fleet.slo
+        ops = fleet.ops  # fleet owns the endpoint (closed by fleet.close)
         logger.info("serving fleet: mesh=%dx%d cache_shards=%d scheduler=%s",
                     serve_cfg.mesh_batch, serve_cfg.mesh_model,
                     serve_cfg.cache_shards, serve_cfg.scheduler)
@@ -130,6 +141,15 @@ def main():
             cache=MPICache(capacity_bytes=serve_cfg.cache_bytes,
                            quant=serve_cfg.cache_quant),
             **engine_kw)
+        slo = telemetry.SLOTracker(objective_ms=serve_cfg.slo_objective_ms,
+                                   target=serve_cfg.slo_target,
+                                   window_s=serve_cfg.slo_window_s)
+        if serve_cfg.ops_port > 0:
+            ops = telemetry.OpsServer(port=serve_cfg.ops_port,
+                                      slo=slo).start()
+    if ops is not None:
+        logger.info("ops endpoint: %s (/metrics /healthz /slo "
+                    "/traces/recent)", ops.url)
 
     paths = _image_paths(args.data_path)
     if not paths:
@@ -148,26 +168,44 @@ def main():
             engine.warmup(gen.image_id)
             t0 = time.perf_counter()  # don't bill compiles to throughput
         name = os.path.basename(path).rsplit(".", 1)[0]
-        for w in gen.render_videos(args.output_dir, name):
-            logger.info("wrote %s", w)
+        # one trace per input image (this CLI's unit of request): the
+        # video-render block is its single child span; the SLO tracker
+        # sees every image regardless of the sampling verdict
+        trace = telemetry.tracing.start("serve.image", image=name)
+        t_img = time.perf_counter()
+        if trace is not None:
+            with trace.child("render_videos"):
+                for w in gen.render_videos(args.output_dir, name):
+                    logger.info("wrote %s", w)
+        else:
+            for w in gen.render_videos(args.output_dir, name):
+                logger.info("wrote %s", w)
+        slo.record((time.perf_counter() - t_img) * 1e3,
+                   bucket=serve_cfg.max_bucket)
+        telemetry.tracing.finish(trace)
         views += sum(t.shape[0] for t in generate_trajectories(
             config.get("data.name", "_default"))[0])
     dt = time.perf_counter() - t0
 
     stats = engine.cache.stats()
+    # the fleet's routing counters ride the ONE stats line (a sharded
+    # cache's stats() carries them; a plain MPICache reads as zeros)
     logger.info("serve stats: entries=%d nbytes=%d hits=%d misses=%d "
-                "evictions=%d quant=%s device_calls=%d sync_encodes=%d",
+                "evictions=%d quant=%s device_calls=%d sync_encodes=%d "
+                "owner_hits=%d remote_routes=%d owner_encodes=%d "
+                "rebalances=%d",
                 stats["entries"], stats["nbytes"], stats["hits"],
                 stats["misses"], stats["evictions"], stats["quant"],
-                engine.device_calls, engine.sync_encodes)
+                engine.device_calls, engine.sync_encodes,
+                stats.get("owner_hits", 0), stats.get("remote_routes", 0),
+                stats.get("owner_encodes", 0), stats.get("rebalances", 0))
     if fleet is not None:
         fs = fleet.stats()
-        logger.info("fleet stats: mesh=%s shards=%d owner_hits=%d "
-                    "remote_routes=%d owner_encodes=%d rebalances=%d",
-                    fs["mesh"], fs["shards"], fs["owner_hits"],
-                    fs["remote_routes"], fs["owner_encodes"],
-                    fs["rebalances"])
+        logger.info("fleet stats: mesh=%s shards=%d slo_breaches=%d",
+                    fs["mesh"], fs["shards"], fs["slo_breaches"])
         fleet.close()
+    elif ops is not None:
+        ops.close()
     logger.info("rendered %d views from %d images in %.2fs (%.2f views/s)",
                 views, len(paths), dt, views / max(dt, 1e-9))
     telemetry.emit("serve.stats", views=views, images=len(paths),

@@ -56,6 +56,8 @@ QUICK = {
     "test_fused_loss.py::test_ssim_pairs_matches_separate_calls",
     "test_step_breakdown.py::test_parse_extracts_all_buckets",
     "test_telemetry.py::test_histogram_quantiles_match_numpy",
+    "test_tracing.py::test_sampling_gate",
+    "test_obs_tools.py::test_report_empty_stream",
     "test_losses.py::test_psnr_analytic",
     "test_mesh.py::test_num_slices",
     "test_models.py::test_positional_encoding_matches_reference_formula",
@@ -112,6 +114,10 @@ MEDIUM_FILES = {
     # frozen st1 step line, bitwise-unchanged instrumented paths): cheap
     # (~25 s) and every other subsystem now routes through it
     "test_telemetry.py",
+    # tracing/SLO/export unit contracts + the obs_report/validate_events
+    # tooling: seconds each, same reviewer concern as test_telemetry
+    "test_tracing.py",
+    "test_obs_tools.py",
     # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
     # eval): the closest thing to a real-data rehearsal, gated here so it
     # can't rot (round-4 VERDICT item 8; ~5 min of the tier's budget)

@@ -1,0 +1,180 @@
+"""tools/obs_report.py + tools/validate_events.py against synthetic streams.
+
+Three contracts pinned here:
+
+  * obs_report degrades loudly, not silently: an empty stream and a stream
+    with zero serve/fleet/trace events each say so explicitly instead of
+    rendering empty serve tables (a report that omits every serve section
+    reads as "serve was healthy" when serve never ran);
+  * the per-trace waterfall section reassembles trace.span events into
+    offset/duration bars and flags incomplete traces (root never emitted);
+  * the schema-drift tripwire: one exemplar of EVERY documented event kind
+    (events.KIND_FIELDS) round-trips through validate_events --strict, and
+    strict mode rejects an event missing a documented field that plain
+    mode waves through. Because the exemplars are generated FROM
+    KIND_FIELDS, documenting a new kind automatically extends this test.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import obs_report  # noqa: E402
+import validate_events  # noqa: E402
+from mine_tpu.telemetry.events import KIND_FIELDS  # noqa: E402
+
+
+def _ev(kind, **fields):
+    rec = {"schema": "mtpu-ev1", "ts": time.time(), "kind": kind}
+    rec.update(fields)
+    return rec
+
+
+def _write(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return str(path)
+
+
+# ---------------- obs_report guards (satellite: empty / no-serve) --------
+
+def test_report_empty_stream():
+    text = obs_report.report([], [])
+    assert "(empty stream — nothing to report)" in text
+    assert "slowest traces" not in text
+    # the no-serve note is for streams WITH events; empty says empty
+    assert "serve path:" not in text
+
+
+def test_report_train_only_stream_names_missing_serve_path(tmp_path):
+    events = [_ev("train.step", gstep=i, step_ms=12.5 + i) for i in range(5)]
+    path = _write(tmp_path / "ev.jsonl", events)
+    rc = obs_report.main([path])
+    assert rc == 0
+    text = obs_report.report(events, [])
+    assert "serve path: no serve/fleet/trace events in this stream." in text
+    assert "step-time" in text
+    for absent in ("slowest traces", "SLO breaches", "serving fleet",
+                   "serve bucket compiles"):
+        assert absent not in text
+
+
+# ---------------- waterfall section ----------------
+
+def _trace_events(tid, root_ms, kids, ok=True, name="serve.request"):
+    """kids: list of (name, ms, t_off_ms, extra_fields)."""
+    root_id = "r" + tid
+    evs = []
+    for i, (kname, ms, off, extra) in enumerate(kids):
+        evs.append(_ev("trace.span", trace=tid, span="s%d%s" % (i, tid),
+                       parent=root_id, name=kname, ms=ms, t_off_ms=off,
+                       **extra))
+    # root last, as tracing.finish emits it
+    evs.append(_ev("trace.span", trace=tid, span=root_id, parent=None,
+                   name=name, ms=root_ms, t_off_ms=0.0, ok=ok))
+    return evs
+
+
+def test_report_waterfall_renders_slowest_traces():
+    events = []
+    events += _trace_events("aaaa", 100.0, [
+        ("route", 0.5, 0.0, {"remote": True}),
+        ("queue", 40.0, 1.0, {"flush_cause": "deadline"}),
+        ("render", 55.0, 45.0, {"compiled": False}),
+    ])
+    events += _trace_events("bbbb", 10.0, [("queue", 9.0, 0.0, {})],
+                            ok=False)
+    text = obs_report.report(events, [])
+    assert "slowest traces (2 of 2 complete):" in text
+    # slowest first
+    assert text.index("trace aaaa") < text.index("trace bbbb")
+    assert "FAILED" in text  # the ok=False trace
+    lines = text.splitlines()
+    queue_row = next(l for l in lines
+                     if "queue" in l and "flush_cause=deadline" in l)
+    # a bar: leading gap dashes then a #-extent, inside brackets
+    assert "[" in queue_row and "#" in queue_row
+    render_row = next(l for l in lines if "render" in l)
+    assert "compiled=False" in render_row
+    # the ~45% offset render span starts deeper into the bar than queue
+    assert render_row.index("#") > queue_row.index("#")
+
+
+def test_report_counts_incomplete_traces():
+    events = _trace_events("cccc", 5.0, [("queue", 1.0, 0.0, {})])
+    # spans for a trace whose root never arrived (request still in flight
+    # or process died): must be counted, not crashed on
+    events.append(_ev("trace.span", trace="dddd", span="x", parent="rdddd",
+                      name="queue", ms=1.0, t_off_ms=0.0))
+    text = obs_report.report(events, [])
+    assert ("slowest traces (1 of 1 complete, 1 incomplete — "
+            "root span never emitted):" in text)
+
+
+def test_report_slo_breach_section():
+    events = [_ev("serve.slo_breach", p99_ms=120.0, objective_ms=50.0,
+                  window_s=60.0, window_n=40, target=0.99,
+                  error_budget_burn=3.2)]
+    text = obs_report.report(events, [])
+    assert "SLO breaches (1):" in text
+    assert "p99=120.0 ms over objective=50.0 ms" in text
+
+
+# ---------------- schema-drift tripwire (validate_events --strict) -------
+
+_EXEMPLAR_VALUES = {
+    "metrics": {"serve.cache.hits": 3},
+    "scope": "serve",
+    "trace_dir": "/tmp/trace",
+    "warp_impl": "xla",
+    "dtype": "bfloat16",
+    "image_id": "img0000",
+    "name": "render",
+    "trace": "a" * 16,
+    "span": "b" * 16,
+    "flush_cause": "full",
+}
+
+
+def _exemplar(kind, fields):
+    payload = {f: _EXEMPLAR_VALUES.get(f, 1.0) for f in fields}
+    return _ev(kind, **payload)
+
+
+def test_every_documented_kind_roundtrips_strict(tmp_path):
+    assert KIND_FIELDS, "documented-kind table went missing"
+    events = [_exemplar(kind, fields)
+              for kind, fields in sorted(KIND_FIELDS.items())]
+    path = _write(tmp_path / "all_kinds.jsonl", events)
+    assert validate_events.main([path, "--strict"]) == 0
+    # and the report renders every documented kind without crashing
+    assert obs_report.main([path]) == 0
+    text = obs_report.report(events, [])
+    assert "events by kind (%d total):" % len(KIND_FIELDS) in text
+
+
+@pytest.mark.parametrize("kind", sorted(KIND_FIELDS))
+def test_strict_rejects_missing_documented_field(tmp_path, kind, capsys):
+    fields = KIND_FIELDS[kind]
+    ev = _exemplar(kind, fields)
+    dropped = sorted(fields)[0]
+    del ev[dropped]
+    path = _write(tmp_path / "drift.jsonl", [ev])
+    # base schema still fine: append-only evolution only ADDS requirements
+    assert validate_events.main([path]) == 0
+    assert validate_events.main([path, "--strict"]) == 1
+    err = capsys.readouterr().err
+    assert kind in err and dropped in err
+
+
+def test_strict_allows_undocumented_kinds(tmp_path):
+    path = _write(tmp_path / "new_kind.jsonl",
+                  [_ev("serve.some_future_kind", anything=1)])
+    assert validate_events.main([path, "--strict"]) == 0

@@ -39,6 +39,8 @@ def test_quick_tier_covers_most_suites():
         "test_plane_sharding.py", # mesh train-step compiles
         "test_multiprocess.py",   # env-gated 2-process job
         "test_crosscheck.py",     # env-gated ~7-min TPU cross-lowering
+        "test_serve_trace_e2e.py",  # every test is slow-marked (two fleets,
+                                    # 2x32 traced requests)
     }
     files = {f for f in os.listdir(HERE)
              if f.startswith("test_") and f.endswith(".py")}

@@ -346,6 +346,25 @@ def test_continuous_batcher_threaded_deadline_dispatch(scene):
         b.close()
 
 
+def test_continuous_batcher_close_joins_dispatch_thread(scene):
+    """Regression: close() must actually JOIN the dispatch thread (bounded),
+    not just flip the flag and hope — a still-running thread after close
+    races teardown and leaks into the next test's engine."""
+    engine = _put_scene(RenderEngine(cache=MPICache(quant="bf16"),
+                                     max_bucket=4), scene)
+    b = ContinuousBatcher(engine, max_requests=4, max_wait_ms=20.0)
+    thread = b._thread
+    assert thread is not None and thread.is_alive()
+    fut = b.submit("img", scene["poses"][0])
+    assert b.close() is True          # joined within the bounded timeout
+    assert b._thread is None          # handle dropped once joined
+    assert not thread.is_alive()
+    # the in-flight request was drained, not abandoned
+    rgb, _ = fut.result(timeout=5)
+    assert rgb.shape == (3, H, W)
+    assert b.close() is True          # idempotent
+
+
 # ---------------- fleet ----------------
 
 def test_serve_fleet_end_to_end(scene):

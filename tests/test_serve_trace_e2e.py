@@ -1,0 +1,199 @@
+"""End-to-end tracing acceptance: a traced fleet, span-complete and bitwise.
+
+The PR-9 standing invariant, driven for real: ≥32 requests through a
+2-device-mesh / 4-shard ServeFleet with tracing ON (sample=1.0) and the
+ops endpoint live must
+
+  * produce EXACTLY one complete trace per request — root `serve.request`
+    span plus `route`/`queue`/`pad`/`render` children, every child's
+    parent id the root's span id, every duration non-negative, and the
+    children's durations summing to no more than the root's wall time
+    (they are disjoint sequential stages of one request);
+  * serve a `/metrics` body that parses under the Prometheus text format
+    and a `/slo` body that saw every request (SLO is never sampled);
+  * render every output BITWISE-identical to the same fleet with tracing
+    off — tracing is host-side bookkeeping only and must never perturb a
+    jitted program or its inputs.
+
+Slow tier: two fleets, 2×32 requests, one funneled event stream.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.data.synthetic import SyntheticMPIDataset
+from mine_tpu.serve import MPICache, RenderEngine, ServeFleet
+from mine_tpu.telemetry import events as tevents
+from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry.export import parse_prometheus
+
+H, W = 12, 16
+S = 4
+N_REQ = 32
+# child spans are disjoint sequential stages, so their sum is bounded by
+# the root's wall time up to per-span rounding (each ms rounds at 3 dp)
+SUM_EPS_MS = 1.0
+
+
+@pytest.fixture(scope="module")
+def scene():
+    ds = SyntheticMPIDataset(seed=3, height=H, width=W, num_planes_gt=S)
+    planes = np.concatenate([np.asarray(ds.mpi_rgb[0]),
+                             np.asarray(ds.mpi_sigma[0])], axis=1)
+    poses = np.tile(np.eye(4, dtype=np.float32), (5, 1, 1))
+    poses[:, 0, 3] = np.linspace(0.0, 0.04, 5)
+    poses[:, 2, 3] = np.linspace(0.0, -0.06, 5)
+    return {"planes": planes.astype(np.float32),
+            "disparity": np.asarray(ds.disparity[0]),
+            "K": np.asarray(ds.K, np.float32),
+            "poses": poses}
+
+
+def _put_scene(engine, scene, key="img"):
+    p = scene["planes"]
+    engine.put(key, p[:, 0:3], p[:, 3:4], scene["disparity"], scene["K"])
+    return engine
+
+
+def _drive(fleet, scene):
+    """Submit N_REQ requests, return outputs in submission order."""
+    futs = [fleet.submit("img", scene["poses"][j % 5]) for j in range(N_REQ)]
+    return [f.result(timeout=60) for f in futs]
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+@pytest.fixture
+def clean_stream(tmp_path, monkeypatch):
+    """Funnel events into a private file; leave tracer + sink re-armed."""
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    tracing.reset()
+    path = tmp_path / "trace_events.jsonl"
+    tevents.configure(str(path))
+    yield path
+    tevents.reset()
+    tracing.reset()
+
+
+@pytest.mark.slow
+def test_fleet_tracing_complete_spans_and_bitwise_parity(scene, clean_stream):
+    # ---- reference: tracing OFF ----
+    fleet_off = ServeFleet(mesh_batch=2, cache_shards=4, max_requests=4,
+                           max_wait_ms=5.0, max_bucket=8, trace_sample=0.0)
+    _put_scene(fleet_off.engine, scene)
+    try:
+        ref = _drive(fleet_off, scene)
+    finally:
+        fleet_off.close()
+    n_traced_off = len([t for t in tracing.recent()
+                        if t["name"] == "serve.request"])
+    assert n_traced_off == 0  # sample=0.0 means zero traces, not fewer
+
+    # ---- traced run: sample=1.0, ops endpoint on an ephemeral port ----
+    tracing.configure(recent_capacity=4 * N_REQ)
+    fleet = ServeFleet(mesh_batch=2, cache_shards=4, max_requests=4,
+                       max_wait_ms=5.0, max_bucket=8, trace_sample=1.0,
+                       slo_objective_ms=10_000.0, ops_port=0)
+    _put_scene(fleet.engine, scene)
+    try:
+        out = _drive(fleet, scene)
+
+        # ---- ops plane, scraped live ----
+        base = fleet.ops.url
+        assert _get(base + "/healthz") == b"ok\n"
+        metrics = parse_prometheus(_get(base + "/metrics").decode())
+        assert metrics["mtpu_serve_trace_finished_total"] >= N_REQ
+        assert metrics['mtpu_serve_trace_e2e_ms_bucket{le="+Inf"}'] >= N_REQ
+        slo = json.loads(_get(base + "/slo"))
+        assert slo["window_n"] == N_REQ  # the SLO tracker is NEVER sampled
+        assert slo["objective_ms"] == 10_000.0 and not slo["breaching"]
+        recent = json.loads(_get(base + "/traces/recent"))["traces"]
+        assert len(recent) >= 1
+    finally:
+        fleet.close()
+
+    # ---- bitwise parity: tracing is host-side only ----
+    for (rgb, depth), (ref_rgb, ref_depth) in zip(out, ref):
+        np.testing.assert_array_equal(rgb, ref_rgb)
+        np.testing.assert_array_equal(depth, ref_depth)
+
+    # ---- the funneled stream holds one COMPLETE trace per request ----
+    tevents.reset()  # close the sink so every line is on disk
+    events = tevents.read_events(str(clean_stream))
+    assert not tevents.validate_file(str(clean_stream), strict_kinds=True)
+    spans = [e for e in events if e["kind"] == "trace.span"]
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+
+    roots = [s for s in spans if s["parent"] is None]
+    assert len(roots) == N_REQ            # exactly one trace per request
+    assert len(by_trace) == N_REQ         # and no orphan trace ids
+    assert len({s["span"] for s in spans}) == len(spans)  # ids unique
+
+    for tid, tspans in by_trace.items():
+        troots = [s for s in tspans if s["parent"] is None]
+        assert len(troots) == 1
+        root = troots[0]
+        assert root["name"] == "serve.request" and root["ok"] is True
+        children = [s for s in tspans if s["parent"] is not None]
+        names = sorted(c["name"] for c in children)
+        # queue -> route -> pad -> render, exactly once each; no encode
+        # (the scene was encoded at put(), before any request)
+        assert names == ["pad", "queue", "render", "route"]
+        by_name = {c["name"]: c for c in children}
+        for c in children:
+            assert c["parent"] == root["span"]  # flat tree under the root
+            assert c["ms"] >= 0.0 and c["t_off_ms"] >= 0.0
+            assert c["t_off_ms"] + c["ms"] <= root["ms"] + SUM_EPS_MS
+        assert sum(c["ms"] for c in children) <= root["ms"] + SUM_EPS_MS
+        # stage order by offset: route (submit) precedes queue (batcher),
+        # which precedes the render call's pad, then render
+        assert (by_name["route"]["t_off_ms"] <= by_name["queue"]["t_off_ms"]
+                <= by_name["pad"]["t_off_ms"]
+                <= by_name["render"]["t_off_ms"])
+        assert by_name["route"]["front_shard"] in range(4)
+        assert by_name["route"]["owner_shard"] in range(4)
+        assert by_name["queue"]["flush_cause"] in ("full", "deadline")
+        assert 1 <= by_name["queue"]["batch_size"] <= 4
+        assert by_name["render"]["mesh"] == "2x1"
+        assert by_name["render"]["devices"] == 2
+
+
+@pytest.mark.slow
+def test_engine_sync_encode_span_attributed(scene, clean_stream):
+    """The one live encode-span path: render(image=...) against a cold
+    cache records the sync encode as a child of THAT request's trace."""
+    from mine_tpu.serve import engine as engine_mod
+
+    p = scene["planes"]
+
+    def encode_fn(img):
+        return p[:, 0:3], p[:, 3:4], scene["disparity"], scene["K"]
+
+    engine = RenderEngine(cache=MPICache(quant="bf16"), max_bucket=4,
+                          encode_fn=encode_fn)
+    engine_mod._warned_sync_encode.discard(id(engine))
+    image = np.zeros((4, 4, 3), np.float32)
+    ctx = tracing.start("serve.request", sample=1.0)
+    with pytest.warns(UserWarning, match="SYNCHRONOUS encode"):
+        engine.render("cold_img", scene["poses"][:1], image=image, trace=ctx)
+    tracing.finish(ctx)
+    trace = tracing.recent(1)[0]
+    names = [s["name"] for s in trace["spans"]]
+    assert names[0] == "serve.request"
+    assert "encode" in names and "render" in names
+    enc = next(s for s in trace["spans"] if s["name"] == "encode")
+    assert enc["sync"] is True and enc["ms"] >= 0.0
+    # warm path: second render of the same key records NO encode span
+    ctx2 = tracing.start("serve.request", sample=1.0)
+    engine.render("cold_img", scene["poses"][:1], image=image, trace=ctx2)
+    tracing.finish(ctx2)
+    assert "encode" not in [s["name"] for s in tracing.recent(1)[0]["spans"]]

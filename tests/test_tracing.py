@@ -1,0 +1,362 @@
+"""Request tracing, SLO tracker, Prometheus export, ops endpoint.
+
+Unit contracts of the three PR-9 telemetry modules in isolation (the serve
+path integration lives in tests/test_serve_fleet.py and the slow
+end-to-end acceptance in tests/test_serve_trace_e2e.py):
+
+  * tracing.py — sampling decisions, span/parent id structure, cross-thread
+    span recording, trace.span event emission, the recent-trace ring;
+  * slo.py — exact sliding-window percentiles, window pruning, edge-
+    triggered breach events, error-budget burn, the /slo snapshot shape;
+  * export.py — Prometheus text round-trip for every metric type, the
+    cumulative-bucket invariants scrapers rely on, and the live HTTP
+    endpoint's four routes.
+
+All host-side and fast: nothing here builds a jax program.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mine_tpu import telemetry
+from mine_tpu.telemetry import events as tevents
+from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry.export import (OpsServer, parse_prometheus,
+                                       prom_name, render_prometheus)
+from mine_tpu.telemetry.registry import MetricsRegistry
+from mine_tpu.telemetry.slo import SLOTracker
+
+
+@pytest.fixture
+def clean_sink(monkeypatch):
+    """No env funnel, nothing configured; re-armed afterwards (the same
+    isolation tests/test_telemetry.py uses)."""
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    yield
+    tevents.reset()
+
+
+@pytest.fixture
+def clean_tracer():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+# ---------------- tracing ----------------
+
+def test_sampling_gate(clean_tracer):
+    # rate 0 (the reset default): no context, no cost
+    assert tracing.start("serve.request") is None
+    # rate 1: always a context
+    tracing.configure(sample=1.0)
+    ctx = tracing.start("serve.request")
+    assert ctx is not None
+    tracing.finish(ctx)
+    # per-call override beats the configured rate both ways
+    assert tracing.start("r", sample=0.0) is None
+    tracing.configure(sample=0.0)
+    assert tracing.start("r", sample=1.0) is not None
+
+
+def test_sampling_rate_is_approximate(clean_tracer):
+    tracing.configure(sample=0.25)
+    n = sum(tracing.start("r") is not None for _ in range(2000))
+    assert 300 < n < 700  # ~500 expected; bounds are ~6 sigma
+
+
+def test_configure_rejects_bad_rates(clean_tracer):
+    with pytest.raises(ValueError):
+        tracing.configure(sample=1.5)
+    with pytest.raises(ValueError):
+        tracing.configure(sample=-0.1)
+    with pytest.raises(ValueError):
+        tracing.configure(recent_capacity=0)
+
+
+def test_trace_child_spans_nest_and_emit(tmp_path, clean_sink, clean_tracer):
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    ctx = tracing.start("serve.request", sample=1.0, image_id="abc")
+    with ctx.child("route", owner_shard=2, remote=True):
+        pass
+    ctx.add_span("queue", 3.25, flush_cause="deadline")
+    tracing.finish(ctx)
+
+    events = tevents.read_events(path)
+    spans = [e for e in events if e["kind"] == "trace.span"]
+    assert len(spans) == 3
+    # strict mode passes for every emitted span
+    assert not tevents.validate_file(path, strict_kinds=True)
+    root = [s for s in spans if s["parent"] is None]
+    assert len(root) == 1 and root[0]["name"] == "serve.request"
+    assert root[0]["ok"] is True and root[0]["image_id"] == "abc"
+    assert root[0]["t_off_ms"] == 0.0
+    kids = {s["name"]: s for s in spans if s["parent"] is not None}
+    assert set(kids) == {"route", "queue"}
+    for s in kids.values():
+        assert s["trace"] == root[0]["trace"]
+        assert s["parent"] == root[0]["span"]
+        assert s["ms"] >= 0.0 and s["t_off_ms"] >= 0.0
+    assert kids["queue"]["ms"] == 3.25
+    assert kids["route"]["owner_shard"] == 2
+    # root emitted LAST: a stream holding the root holds the whole trace
+    assert spans[-1]["parent"] is None
+
+
+def test_trace_ids_unique_and_hex(clean_tracer):
+    ids = set()
+    for _ in range(64):
+        ctx = tracing.start("r", sample=1.0)
+        ids.add(ctx.trace_id)
+        ids.add(ctx.root_id)
+        int(ctx.trace_id, 16)  # 64-bit hex
+        assert len(ctx.trace_id) == 16
+        tracing.finish(ctx)
+    assert len(ids) == 128
+
+
+def test_finish_idempotent_and_seals(clean_tracer):
+    tracing.configure(sample=1.0)
+    ctx = tracing.start("r")
+    ctx.add_span("a", 1.0)
+    tracing.finish(ctx)
+    first_total = ctx.total_ms
+    tracing.finish(ctx)  # no-op
+    assert ctx.total_ms == first_total
+    # sealed: late spans (a thread finishing after the future resolved)
+    # are dropped, not appended to a published trace
+    assert ctx.add_span("late", 1.0) is None
+    assert len(tracing.recent()) == 1
+    assert [s["name"] for s in tracing.recent()[0]["spans"]] == ["r", "a"]
+
+
+def test_finish_none_is_noop(clean_tracer):
+    tracing.finish(None)  # the unsampled-request path: must not raise
+
+
+def test_spans_recorded_across_threads(clean_tracer):
+    tracing.configure(sample=1.0)
+    ctx = tracing.start("r")
+
+    def worker(i):
+        ctx.add_span("work", 1.0, thread=i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tracing.finish(ctx)
+    trace = tracing.recent()[0]
+    workers = [s for s in trace["spans"] if s["name"] == "work"]
+    assert len(workers) == 8
+    assert len({s["span"] for s in workers}) == 8
+
+
+def test_recent_ring_caps_and_orders(clean_tracer):
+    tracing.configure(sample=1.0, recent_capacity=4)
+    for i in range(6):
+        ctx = tracing.start("r", seq=i)
+        tracing.finish(ctx)
+    recent = tracing.recent()
+    assert len(recent) == 4  # capacity
+    seqs = [t["spans"][0]["seq"] for t in recent]
+    assert seqs == [5, 4, 3, 2]  # newest first
+    assert len(tracing.recent(2)) == 2
+    # recent() is JSON-safe by construction (what /traces/recent serves)
+    json.dumps(recent)
+
+
+def test_unsampled_trace_emits_nothing(tmp_path, clean_sink, clean_tracer):
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    assert tracing.start("r") is None  # sample=0
+    tevents.current_sink().close()
+    import os
+    assert not os.path.exists(path) or open(path).read() == ""
+
+
+# ---------------- SLO tracker ----------------
+
+def test_slo_rejects_bad_params():
+    with pytest.raises(ValueError):
+        SLOTracker(target=1.0)
+    with pytest.raises(ValueError):
+        SLOTracker(target=0.0)
+    with pytest.raises(ValueError):
+        SLOTracker(window_s=0.0)
+    with pytest.raises(ValueError):
+        SLOTracker(objective_ms=-1.0)
+
+
+def test_slo_window_percentiles_exact():
+    t = SLOTracker(objective_ms=0.0, window_s=100.0)
+    for i in range(1, 101):  # 1..100 ms
+        t.record(float(i), now=0.0)
+    snap = t.snapshot(now=0.0)
+    assert snap["window_n"] == 100
+    assert snap["p50_ms"] == pytest.approx(50.5)
+    assert snap["p99_ms"] == pytest.approx(99.01)
+    assert snap["breaching"] is False and snap["breaches"] == 0
+
+
+def test_slo_window_prunes_by_age():
+    t = SLOTracker(window_s=10.0)
+    t.record(100.0, now=0.0)
+    t.record(1.0, now=9.0)
+    assert t.snapshot(now=9.0)["window_n"] == 2
+    snap = t.snapshot(now=15.0)  # the t=0 sample aged out
+    assert snap["window_n"] == 1
+    assert snap["p99_ms"] == pytest.approx(1.0)
+
+
+def test_slo_breach_edge_triggered(tmp_path, monkeypatch):
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    t = SLOTracker(objective_ms=10.0, target=0.9, window_s=1000.0)
+    # below MIN_BREACH_SAMPLES nothing can breach, however slow
+    for i in range(10):
+        t.record(500.0, now=float(i))
+    assert not t.breaching
+    # push past the sample floor with slow requests: ONE breach event
+    for i in range(10, 40):
+        t.record(500.0, now=float(i))
+    assert t.breaching and t.breaches == 1
+    # recovery: fresh window of fast requests clears the state...
+    for i in range(40, 80):
+        t.record(1.0, now=float(i + 2000))
+    assert not t.breaching
+    # ...and a second excursion is a SECOND event, not a suppressed one
+    for i in range(80, 120):
+        t.record(500.0, now=float(i + 4000))
+    assert t.breaches == 2
+    breaches = [e for e in tevents.read_events(path)
+                if e["kind"] == "serve.slo_breach"]
+    assert len(breaches) == 2
+    assert breaches[0]["objective_ms"] == 10.0
+    assert breaches[0]["p99_ms"] > 10.0
+    assert not tevents.validate_file(path, strict_kinds=True)
+    tevents.reset()
+
+
+def test_slo_error_budget_burn():
+    # target 0.9 -> 10% budget; 25% of the window bad -> burn 2.5x
+    t = SLOTracker(objective_ms=10.0, target=0.9, window_s=1000.0)
+    for i in range(100):
+        t.record(100.0 if i % 4 == 0 else 1.0, now=float(i))
+    snap = t.snapshot(now=99.0)
+    assert snap["error_budget_burn"] == pytest.approx(2.5)
+    assert telemetry.REGISTRY.gauge(
+        "serve.slo.error_budget_burn").value == pytest.approx(2.5)
+
+
+def test_slo_per_bucket_breakdown():
+    t = SLOTracker(window_s=1000.0)
+    for _ in range(10):
+        t.record(1.0, bucket=4, now=0.0)
+    for _ in range(10):
+        t.record(8.0, bucket=8, now=0.0)
+    snap = t.snapshot(now=0.0)
+    assert snap["buckets"]["4"]["p50_ms"] == pytest.approx(1.0)
+    assert snap["buckets"]["8"]["p50_ms"] == pytest.approx(8.0)
+    json.dumps(snap)  # /slo body
+
+
+# ---------------- Prometheus export ----------------
+
+def test_prom_name_sanitizes():
+    assert prom_name("serve.cache.hits") == "mtpu_serve_cache_hits"
+    assert prom_name("a-b c") == "mtpu_a_b_c"
+
+
+def test_render_parse_roundtrip_all_types():
+    reg = MetricsRegistry()
+    reg.counter("serve.reqs").inc(7)
+    reg.gauge("serve.cache.bytes").set(1.5e6)
+    h = reg.histogram("serve.lat_ms", edges=[1.0, 10.0, 100.0])
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.record(v)
+    text = render_prometheus(reg)
+    assert text.endswith("\n")
+    parsed = parse_prometheus(text)
+    assert parsed["mtpu_serve_reqs_total"] == 7
+    assert parsed["mtpu_serve_cache_bytes"] == 1.5e6
+    # cumulative buckets, monotone, +Inf == _count == all samples
+    b = [parsed['mtpu_serve_lat_ms_bucket{le="1"}'],
+         parsed['mtpu_serve_lat_ms_bucket{le="10"}'],
+         parsed['mtpu_serve_lat_ms_bucket{le="100"}'],
+         parsed['mtpu_serve_lat_ms_bucket{le="+Inf"}']]
+    assert b == [1, 2, 3, 4]
+    assert parsed["mtpu_serve_lat_ms_count"] == 4
+    assert parsed["mtpu_serve_lat_ms_sum"] == pytest.approx(555.5)
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus("not a metric line at all!")
+    with pytest.raises(ValueError):
+        parse_prometheus("dup 1\ndup 2")
+    # comments and blanks pass through
+    assert parse_prometheus("# HELP x y\n\n") == {}
+
+
+def test_histogram_bucket_counts_view():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", edges=[1.0, 2.0])
+    for v in (0.5, 1.5, 99.0):
+        h.record(v)
+    edges, counts = h.bucket_counts()
+    assert edges == (1.0, 2.0)
+    assert counts == (1, 1, 1)  # <=1, <=2, overflow
+
+
+# ---------------- ops endpoint ----------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read(), r.headers.get("Content-Type", "")
+
+
+def test_ops_server_routes(clean_tracer):
+    tracing.configure(sample=1.0)
+    ctx = tracing.start("serve.request")
+    tracing.finish(ctx)
+    slo = SLOTracker(objective_ms=50.0)
+    slo.record(5.0)
+    reg = MetricsRegistry()
+    reg.counter("serve.reqs").inc()
+    srv = OpsServer(port=0, registry=reg, slo=slo).start()
+    try:
+        code, body, ctype = _get(srv.url + "/healthz")
+        assert code == 200 and body == b"ok\n"
+        code, body, ctype = _get(srv.url + "/metrics")
+        assert code == 200 and "text/plain" in ctype
+        assert parse_prometheus(body.decode())["mtpu_serve_reqs_total"] == 1
+        code, body, _ = _get(srv.url + "/slo")
+        snap = json.loads(body)
+        assert snap["objective_ms"] == 50.0 and snap["window_n"] == 1
+        code, body, _ = _get(srv.url + "/traces/recent")
+        traces = json.loads(body)["traces"]
+        assert len(traces) == 1 and traces[0]["name"] == "serve.request"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + "/nope")
+        assert exc.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_ops_server_close_joins_thread():
+    srv = OpsServer(port=0)
+    srv.start()
+    thread = srv._thread
+    srv.close()
+    assert thread is not None and not thread.is_alive()
+    assert srv._thread is None

@@ -11,8 +11,12 @@ Consumes the two parseable streams the telemetry layer emits:
 
 and prints: event counts by kind, span wall-clock stats (count/mean/p50/
 p90/p99 per span path), step-time aggregates, serve bucket-compile history,
-serving-fleet cache placements/rebalances (serve.shard.* events),
-profiler trace windows, and the final metrics snapshot if one was emitted.
+serving-fleet cache placements/rebalances (serve.shard.* events), SLO
+breaches (serve.slo_breach), the slowest request traces as per-trace
+waterfalls (trace.span events, telemetry/tracing.py), profiler trace
+windows, and the final metrics snapshot if one was emitted. Sections with
+nothing behind them are omitted; a stream with no serve/fleet events says
+so explicitly instead of printing empty serve tables.
 
 Usage:
   python tools/obs_report.py EVENTS.jsonl [--log TRAIN.log ...]
@@ -51,10 +55,58 @@ def _stat_row(name, vals):
                _pct(vals, 0.5), _pct(vals, 0.9), _pct(vals, 0.99)))
 
 
+WATERFALL_WIDTH = 32
+SLOWEST_TRACES = 5
+
+
+def _group_traces(events):
+    """trace.span events -> list of {trace, root, children} dicts for
+    COMPLETE traces (root emitted, which tracing.finish does last), plus
+    the count of incomplete trace ids (spans seen, no root)."""
+    by_trace = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "trace.span" and e.get("trace"):
+            by_trace[e["trace"]].append(e)
+    complete, incomplete = [], 0
+    for tid, spans in by_trace.items():
+        roots = [s for s in spans if s.get("parent") is None]
+        if not roots:
+            incomplete += 1
+            continue
+        children = sorted((s for s in spans if s.get("parent") is not None),
+                          key=lambda s: float(s.get("t_off_ms", 0.0)))
+        complete.append({"trace": tid, "root": roots[0],
+                         "children": children})
+    return complete, incomplete
+
+
+def _waterfall_row(span, total_ms):
+    """One child span as an offset/duration bar against the root's span:
+    '-' leading gap, '#' the span's extent (always >= 1 cell)."""
+    off = float(span.get("t_off_ms", 0.0))
+    ms = float(span.get("ms", 0.0))
+    total = max(total_ms, 1e-9)
+    start = min(WATERFALL_WIDTH - 1,
+                max(0, int(round(off / total * WATERFALL_WIDTH))))
+    width = max(1, int(round(ms / total * WATERFALL_WIDTH)))
+    width = min(width, WATERFALL_WIDTH - start)
+    bar = "-" * start + "#" * width
+    bar += " " * (WATERFALL_WIDTH - len(bar))
+    extras = []
+    for key in ("flush_cause", "remote", "compiled", "mesh", "sync"):
+        if key in span:
+            extras.append("%s=%s" % (key, span[key]))
+    return ("    [%s] %-8s %9.2f ms  +%.2f  %s"
+            % (bar, span.get("name", "?"), ms, off,
+               " ".join(extras))).rstrip()
+
+
 def report(events, log_lines):
     out = []
     kinds = TallyCounter(e.get("kind", "?") for e in events)
     out.append("events by kind (%d total):" % len(events))
+    if not events:
+        out.append("  (empty stream — nothing to report)")
     for kind, n in sorted(kinds.items()):
         out.append("  %-32s %7d" % (kind, n))
 
@@ -118,6 +170,40 @@ def report(events, log_lines):
                        % (e.get("from_shards"), e.get("to_shards"),
                           e.get("moved"), e.get("entries")))
 
+    breaches = [e for e in events if e.get("kind") == "serve.slo_breach"]
+    if breaches:
+        out.append("")
+        out.append("SLO breaches (%d):" % len(breaches))
+        for e in breaches:
+            out.append("  p99=%.1f ms over objective=%.1f ms "
+                       "(window %ss, n=%s, budget burn %sx)"
+                       % (float(e.get("p99_ms", 0.0)),
+                          float(e.get("objective_ms", 0.0)),
+                          e.get("window_s"), e.get("window_n"),
+                          e.get("error_budget_burn")))
+
+    traces, incomplete = _group_traces(events)
+    if traces or incomplete:
+        out.append("")
+        slowest = sorted(traces,
+                         key=lambda t: -float(t["root"].get("ms", 0.0)))
+        slowest = slowest[:SLOWEST_TRACES]
+        head = ("slowest traces (%d of %d complete"
+                % (len(slowest), len(traces)))
+        if incomplete:
+            head += ", %d incomplete — root span never emitted" % incomplete
+        out.append(head + "):")
+        for t in slowest:
+            root = t["root"]
+            out.append("  trace %s %-16s %9.2f ms  %s"
+                       % (root.get("trace", "?")[:16],
+                          root.get("name", "?"),
+                          float(root.get("ms", 0.0)),
+                          "ok" if root.get("ok", True) else "FAILED"))
+            total = float(root.get("ms", 0.0))
+            for child in t["children"]:
+                out.append(_waterfall_row(child, total))
+
     windows = [e for e in events if e.get("kind") == "profile.window"]
     for e in windows:
         out.append("")
@@ -128,12 +214,24 @@ def report(events, log_lines):
     snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
     if snaps:
         last = snaps[-1]
+        metrics = last.get("metrics") or {}
         out.append("")
         out.append("final metrics snapshot (scope=%s):" % last.get("scope"))
-        for name, v in sorted((last.get("metrics") or {}).items()):
+        if not metrics:
+            out.append("  (snapshot carried no metrics)")
+        for name, v in sorted(metrics.items()):
             if isinstance(v, dict):  # histogram stat dict
                 v = json.dumps(v, sort_keys=True)
             out.append("  %-32s %s" % (name, v))
+
+    # a stream with events but no serve-path activity says so, instead of
+    # silently omitting every serve section (which reads as "serve was
+    # healthy" when it actually never ran)
+    if events and not any(
+            str(e.get("kind", "")).startswith(("serve.", "trace."))
+            for e in events):
+        out.append("")
+        out.append("serve path: no serve/fleet/trace events in this stream.")
     return "\n".join(out)
 
 

@@ -12,6 +12,12 @@ an unparseable stream.
 Usage: python tools/validate_events.py EVENTS.jsonl [MORE.jsonl ...]
        (a missing file is an error — the caller asserting a stream exists
         is part of the check; pass --allow-missing to tolerate it)
+
+--strict additionally pins every DOCUMENTED kind's payload
+(events.KIND_FIELDS): a train.step without step_ms, a trace.span without
+its trace/span ids, a slo_point without its percentiles all fail. This is
+the schema-drift tripwire — mtpu-ev1 evolution is append-only, so a
+documented field disappearing from an emitter is always a bug.
 """
 
 from __future__ import annotations
@@ -31,6 +37,9 @@ def main(argv=None) -> int:
     parser.add_argument("files", nargs="+")
     parser.add_argument("--allow-missing", action="store_true",
                         help="treat a nonexistent file as vacuously valid")
+    parser.add_argument("--strict", action="store_true",
+                        help="also require every documented kind's pinned "
+                             "payload fields (events.KIND_FIELDS)")
     args = parser.parse_args(argv)
 
     failed = False
@@ -42,7 +51,7 @@ def main(argv=None) -> int:
             print("%s: no such file" % path, file=sys.stderr)
             failed = True
             continue
-        errors = validate_file(path)
+        errors = validate_file(path, strict_kinds=args.strict)
         if errors:
             failed = True
             for err in errors:

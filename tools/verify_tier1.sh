@@ -44,10 +44,23 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 
 # every line of the event stream must satisfy the mtpu-ev1 schema — a
-# subsystem that emits malformed events fails tier-1 loudly here
-if ! python tools/validate_events.py --allow-missing "$EVENTS"; then
+# subsystem that emits malformed events fails tier-1 loudly here. --strict
+# additionally pins every documented kind's payload (events.KIND_FIELDS):
+# the schema-drift tripwire for the append-only mtpu-ev1 contract.
+if ! python tools/validate_events.py --allow-missing --strict "$EVENTS"; then
     echo "EVENT_SCHEMA: telemetry event stream failed validation ($EVENTS)"
     [ "$rc" -eq 0 ] && rc=1
+fi
+
+# the reporting path itself is CI smoke: obs_report must render the
+# suite's funneled stream without crashing (mirrors the validate gate —
+# a report bug would otherwise only surface when a human needs the report)
+if [ -f "$EVENTS" ]; then
+    if ! python tools/obs_report.py "$EVENTS" > /tmp/_t1_obs_report.txt; then
+        echo "OBS_REPORT: tools/obs_report.py failed on the suite's event" \
+             "stream ($EVENTS — report attempt in /tmp/_t1_obs_report.txt)"
+        [ "$rc" -eq 0 ] && rc=1
+    fi
 fi
 
 # 'X' (xpass) joins the dot classes so an xpassing line can't silently

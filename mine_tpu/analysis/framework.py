@@ -1,0 +1,154 @@
+"""Audit pass framework: AuditPass / PassResult / run_audit + baseline IO.
+
+A pass inspects one program (jaxpr, StableHLO text, or an instrumented
+execution) and returns a PassResult; `run_audit` crosses the registered
+pass suite with the program registry and collects every result. Global
+passes (scope="global", e.g. the concurrency lint) run once per audit
+instead of once per program.
+
+Budgets live in ONE checked-in file, tools/analysis_baseline.json, with the
+same update discipline as tools/tier1_baseline.txt: `tools/audit.py
+--update-baseline` rewrites it only from a green measurement run, in the
+same commit as the intentional program change (with a CHANGES.md line
+saying why). A budget mismatch in either direction fails — a silent FLOP
+DROP is as suspicious as growth (an optimization landed untested, or a
+term went missing).
+
+Every pass implements `selftest()`: build a seeded violation fixture, run
+the pass's detection logic on it, and return the (necessarily failing)
+PassResult — `tools/audit.py --selftest` asserts each one fails, proving
+the lint actually detects what it claims to.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+BASELINE_SCHEMA = "mtpu-audit1"
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE_PATH = os.path.join(REPO_ROOT, "tools",
+                                     "analysis_baseline.json")
+
+
+@dataclasses.dataclass
+class PassResult:
+    pass_name: str
+    program: str           # program name, or "-" for global passes
+    ok: bool
+    details: str = ""
+    data: Dict = dataclasses.field(default_factory=dict)
+    skipped: bool = False
+
+    def line(self) -> str:
+        status = "SKIP" if self.skipped else ("ok" if self.ok else "FAIL")
+        head = f"[{status:>4}] {self.pass_name:<16} {self.program:<20}"
+        if not self.details:
+            return head
+        first, *rest = self.details.splitlines()
+        out = f"{head} {first}"
+        for r in rest:
+            out += "\n" + " " * 8 + r
+        return out
+
+
+class AuditPass:
+    """Base pass. Subclasses set `name`, implement `run(program)` and
+    `selftest()`, and may narrow `applies_to`."""
+
+    name = "abstract"
+    scope = "program"  # or "global"
+
+    def applies_to(self, program) -> bool:
+        return True
+
+    def run(self, program) -> PassResult:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def run_global(self) -> PassResult:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def selftest(self) -> PassResult:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _result(self, program, ok: bool, details: str = "",
+                **data) -> PassResult:
+        pname = program if isinstance(program, str) else program.name
+        return PassResult(pass_name=self.name, program=pname, ok=ok,
+                          details=details, data=data)
+
+    def _skip(self, program, why: str) -> PassResult:
+        r = self._result(program, ok=True, details=why)
+        r.skipped = True
+        return r
+
+
+# ------------------------------------------------------------- baseline IO
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict:
+    """Checked-in budget file; a missing file returns an empty skeleton so
+    the budget pass can say 'run --update-baseline' per program instead of
+    crashing the whole audit."""
+    if not os.path.exists(path):
+        return {"schema": BASELINE_SCHEMA, "programs": {}, "budgets": {}}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: unknown baseline schema {data.get('schema')!r} "
+            f"(expected {BASELINE_SCHEMA!r})")
+    data.setdefault("programs", {})
+    data.setdefault("budgets", {})
+    return data
+
+
+def save_baseline(data: Dict, path: str = DEFAULT_BASELINE_PATH) -> None:
+    data = dict(data)
+    data["schema"] = BASELINE_SCHEMA
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------- running
+
+def run_audit(programs: List, passes: List[AuditPass]) -> List[PassResult]:
+    """Cross the pass suite with the programs. Per-program passes run for
+    every program they apply to; global passes run once, last (so e.g. the
+    concurrency lint's thread-leak check isn't confused by lazily-built
+    program state mid-audit)."""
+    results: List[PassResult] = []
+    for p in passes:
+        if p.scope == "global":
+            continue
+        for prog in programs:
+            if not p.applies_to(prog):
+                continue
+            try:
+                results.append(p.run(prog))
+            except Exception as e:  # a crashing pass is a failing pass
+                results.append(PassResult(
+                    pass_name=p.name, program=prog.name, ok=False,
+                    details=f"pass crashed: {type(e).__name__}: {e}"))
+    for p in passes:
+        if p.scope != "global":
+            continue
+        try:
+            results.append(p.run_global())
+        except Exception as e:
+            results.append(PassResult(
+                pass_name=p.name, program="-", ok=False,
+                details=f"pass crashed: {type(e).__name__}: {e}"))
+    return results
+
+
+def format_report(results: List[PassResult]) -> str:
+    lines = [r.line() for r in results]
+    n_fail = sum(1 for r in results if not r.ok)
+    n_skip = sum(1 for r in results if r.skipped)
+    lines.append(f"audit: {len(results)} checks, {n_fail} failed, "
+                 f"{n_skip} skipped")
+    return "\n".join(lines)

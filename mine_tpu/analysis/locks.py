@@ -1,0 +1,179 @@
+"""Rank-ordered locks: the global acquisition order for host-side threads.
+
+The serve plane is genuinely multithreaded — submitters, the batcher flush
+thread, the ops-server handler threads and the train loop all cross the
+telemetry locks — and a deadlock there would wedge a fleet, not a test. The
+classic discipline is a GLOBAL LOCK ORDER: every named lock carries a rank,
+and a thread may only acquire ranks strictly above everything it already
+holds. This module enforces that dynamically: each wrapped lock records
+itself in a thread-local held-stack on acquire, and an acquisition at a
+rank <= the highest held rank records a LockOrderViolation (it does NOT
+raise — the lint must observe production code paths without changing their
+behavior; the concurrency audit pass fails on the recorded evidence).
+
+LOCK_RANKS below is the canonical order, derived from the one real nesting
+in the codebase (events.configure holds the state lock while closing the
+sink) plus the call sequences of every instrumented path; it is asserted by
+the concurrency pass under a live threaded serve workload.
+
+Stdlib-only and import-light by design: telemetry/ and serve/ modules
+import this at module load, so it must never import jax or mine_tpu.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+# The global acquisition order (ascending = allowed nesting direction).
+# Adding a lock: pick a rank consistent with every path that can hold it
+# together with another instrumented lock, and note the path here.
+#   batcher.cv        held only around queue list ops; never over telemetry
+#   tracing ctx       add_span/finish take it, release, then emit events
+#   tracing tracer    start/finish take it alone or after ctx released
+#   slo               record() releases it before setting registry gauges
+#   registry/metric   registry lock creates metrics; metric locks nest never
+#   events state->sink  configure() closes the old sink under the state lock
+#                       — the one genuine nesting, hence state < sink
+LOCK_RANKS: Dict[str, int] = {
+    "serve.batcher.cv": 10,
+    "telemetry.tracing.ctx": 20,
+    "telemetry.tracing.tracer": 30,
+    "telemetry.slo": 40,
+    "telemetry.registry.registry": 50,
+    "telemetry.registry.metric": 55,
+    "telemetry.events.state": 60,
+    "telemetry.events.sink": 70,
+}
+
+_MAX_VIOLATIONS = 256  # bounded evidence; a runaway path can't eat memory
+
+_tls = threading.local()
+_violations_lock = threading.Lock()
+_violations: List[Dict] = []
+
+
+class LockOrderViolation(RuntimeError):
+    """Raised only by tests that opt in; the monitor itself records."""
+
+
+def _held() -> List["OrderedLock"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _record_violation(lock: "OrderedLock", held: List["OrderedLock"]) -> None:
+    rec = {"thread": threading.current_thread().name,
+           "acquiring": lock.name, "acquiring_rank": lock.rank,
+           "held": [(h.name, h.rank) for h in held]}
+    with _violations_lock:
+        if len(_violations) < _MAX_VIOLATIONS:
+            _violations.append(rec)
+
+
+def violations(clear: bool = False) -> List[Dict]:
+    """Recorded lock-order violations (process-wide). `clear` resets —
+    the concurrency pass clears before its workload and asserts after."""
+    with _violations_lock:
+        out = list(_violations)
+        if clear:
+            del _violations[:]
+    return out
+
+
+class OrderedLock:
+    """A threading.Lock wrapper carrying a (name, rank) and feeding the
+    order monitor. API-compatible where the codebase needs it: acquire/
+    release/context manager/locked, and usable as the `lock=` argument of
+    threading.Condition (whose non-blocking `_is_owned` probe is handled:
+    a FAILED acquire never touches the held-stack or the monitor)."""
+
+    __slots__ = ("name", "rank", "_lock")
+
+    def __init__(self, name: str, rank: Optional[int] = None):
+        if rank is None:
+            if name not in LOCK_RANKS:
+                raise KeyError(
+                    f"lock {name!r} has no entry in LOCK_RANKS; add one "
+                    f"(with a comment deriving its rank) or pass rank=")
+            rank = LOCK_RANKS[name]
+        self.name = name
+        self.rank = int(rank)
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held = _held()
+            # order check on SUCCESSFUL acquisition: any already-held lock
+            # at an equal-or-higher rank means this thread is nesting
+            # against the global order (equal ranks are unordered peers —
+            # nesting two of them is a violation too)
+            if held and max(h.rank for h in held) >= self.rank:
+                _record_violation(self, held)
+            held.append(self)
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        # release order is unconstrained; drop the most recent entry for
+        # this lock object (locks are non-reentrant: at most one entry)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"OrderedLock({self.name!r}, rank={self.rank})"
+
+
+def ordered_lock(name: str, rank: Optional[int] = None) -> OrderedLock:
+    """The instrumented replacement for `threading.Lock()` at a named
+    call site: `self._lock = ordered_lock("telemetry.slo")`."""
+    return OrderedLock(name, rank)
+
+
+def ordered_condition(name: str,
+                      rank: Optional[int] = None) -> threading.Condition:
+    """A threading.Condition over an OrderedLock (Condition accepts any
+    lock object with acquire/release): wait/notify work unchanged, and
+    every acquisition of the underlying lock feeds the order monitor."""
+    return threading.Condition(lock=OrderedLock(name, rank))
+
+
+# --------------------------------------------------------------- threads
+
+# the thread names the serve plane owns and must JOIN on close() — an
+# alive one after teardown is the unjoined-thread regression (PR-8)
+OWNED_THREAD_NAMES = ("mine-tpu-serve-batcher", "mine-tpu-ops-server")
+
+
+def leaked_threads(baseline=None):
+    """Threads that should not survive a clean teardown: non-daemon
+    threads other than the main thread, plus alive daemons with an
+    OWNED_THREAD_NAMES name (those have explicit close()/join paths, so
+    one still alive means somebody forgot to close). `baseline` is an
+    optional set of threads to ignore (captured before the workload)."""
+    baseline = baseline or ()
+    out = []
+    for t in threading.enumerate():
+        if t is threading.main_thread() or t in baseline or not t.is_alive():
+            continue
+        if not t.daemon:
+            out.append(t)
+        elif any(t.name.startswith(n) for n in OWNED_THREAD_NAMES):
+            out.append(t)
+    return out

@@ -1,0 +1,417 @@
+"""The registered audit passes. Each detects one silent program regression:
+
+  dtype_upcast      bf16->f32 converts inside conv-stack scopes (StableHLO)
+  dot_budget        dot_general count / FLOPs vs tools/analysis_baseline.json
+  recompile_churn   a second identically-shaped call must hit the jit cache
+  transfer_guard    hot paths run clean under jax.transfer_guard("disallow")
+  donation          donated buffers actually consumed (deleted, no warning)
+  concurrency       global lock-acquisition order + thread-leak check over a
+                    live threaded serve workload (global pass)
+
+Every pass ships `selftest()`: it seeds the violation the pass exists to
+catch (an unjustified conv-scope upcast, a budget mismatch, a weak-type
+retrace, an implicit host transfer, a dropped donation, a lock-order
+inversion) and returns the pass's verdict on that fixture — which MUST be
+a failure. `tools/audit.py --selftest` gates on exactly that.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mine_tpu.analysis import dtype as _dtype
+from mine_tpu.analysis import flops as _flops
+from mine_tpu.analysis import locks as _locks
+from mine_tpu.analysis.framework import AuditPass, PassResult
+
+
+# ------------------------------------------------------------ dtype upcast
+
+class DtypeUpcastPass(AuditPass):
+    """Generalizes tools/dtype_audit.py to every registered program: fail
+    on any bf16->f32 convert inside an encoder/decoder conv scope that no
+    JUSTIFIED annotation covers (f32 BN stats, loss graph, optimizer math
+    remain allowed by declaration)."""
+
+    name = "dtype_upcast"
+
+    def _check_text(self, program_name: str, text: str) -> PassResult:
+        upcasts = _dtype.collect_upcasts(text)
+        bad = _dtype.suspects(upcasts)
+        if bad:
+            el = sum(u["elements"] for u in bad)
+            worst = sorted(bad, key=lambda u: -u["elements"])[:3]
+            det = (f"{len(bad)} unjustified conv-stack upcasts "
+                   f"({el / 1e6:.2f} M elements); worst: "
+                   + "; ".join(f"{u['shape']} @ {u['scope'][:48]}"
+                               for u in worst))
+            return self._result(program_name, ok=False, details=det,
+                                suspects=len(bad), elements=el)
+        return self._result(
+            program_name, ok=True,
+            details=f"{len(upcasts)} converts, conv-stack clean",
+            converts=len(upcasts))
+
+    def run(self, program) -> PassResult:
+        return self._check_text(program.name, program.stablehlo())
+
+    def selftest(self) -> PassResult:
+        seeded = """
+module @jit_bad {
+  func.func public @main() {
+    %0 = stablehlo.convert %a : (tensor<2x64x96x256xbf16>) -> tensor<2x64x96x256xf32> loc(#loc1)
+  }
+}
+#loc1 = loc("jit(step)/encoder/resnet/conv3/convert_element_type"(#loc9))
+"""
+        return self._check_text("selftest[conv-upcast]", seeded)
+
+
+# -------------------------------------------------------------- dot budget
+
+class DotBudgetPass(AuditPass):
+    """Per-program dot_general count and FLOP budget, pinned exactly in
+    tools/analysis_baseline.json (one source of truth, absorbing the old
+    in-test dot-count gates). Mismatch in EITHER direction fails; update
+    with `tools/audit.py --update-baseline` in the same commit as the
+    intentional program change."""
+
+    name = "dot_budget"
+
+    def __init__(self, baseline: Dict):
+        self.baseline = baseline
+
+    def measure(self, program) -> Dict:
+        jaxpr = program.jaxpr()
+        out = {"dots": _flops.count_dots(jaxpr),
+               "dot_flops": _flops.dot_flops(jaxpr)}
+        if program.name.startswith("fused_loss"):
+            # the PR-2 acceptance gate, now framework-owned: Toeplitz blur
+            # einsums in the loss graph (tests assert the same number)
+            out["blur_dots"] = _flops.count_blur_dots(jaxpr)
+        return out
+
+    def run(self, program) -> PassResult:
+        measured = self.measure(program)
+        expected = self.baseline.get("programs", {}).get(program.name)
+        if expected is None:
+            return self._result(
+                program, ok=False,
+                details="no baseline entry — run tools/audit.py "
+                        "--update-baseline on a green build",
+                measured=measured)
+        diffs = [f"{k}: measured {measured[k]} != baseline {expected[k]}"
+                 for k in sorted(set(measured) | set(expected))
+                 if measured.get(k) != expected.get(k)]
+        if diffs:
+            return self._result(program, ok=False,
+                                details="; ".join(diffs),
+                                measured=measured, expected=expected)
+        det = ", ".join(f"{k}={measured[k]}" for k in sorted(measured))
+        return self._result(program, ok=True, details=det,
+                            measured=measured)
+
+    def selftest(self) -> PassResult:
+        from mine_tpu.analysis.programs import Program
+
+        def mm(a, b):
+            return a @ b
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        y = jnp.zeros((8, 2), jnp.float32)
+        prog = Program(name="selftest[budget]", jit_fn=jax.jit(mm),
+                       args_fn=lambda: (x, y))
+        seeded = DotBudgetPass(
+            {"programs": {"selftest[budget]": {"dots": 0, "dot_flops": 0}}})
+        return seeded.run(prog)
+
+
+# --------------------------------------------------------- recompile churn
+
+class RecompileChurnPass(AuditPass):
+    """Dispatch each program twice with independently materialized but
+    aval-identical inputs: the second call must hit the jit cache. A miss
+    means input construction churns weak_type/dtype/sharding — the compile-
+    churn failure mode that silently serializes a serving fleet."""
+
+    name = "recompile_churn"
+
+    def _check_fn(self, program_name: str, jit_fn, args_fn) -> PassResult:
+        size0 = getattr(jit_fn, "_cache_size", lambda: None)()
+        if size0 is None:
+            return self._skip(program_name,
+                              "jit cache not introspectable on this fn")
+        out = jit_fn(*args_fn())
+        jax.block_until_ready(out)
+        size1 = jit_fn._cache_size()
+        out = jit_fn(*args_fn())
+        jax.block_until_ready(out)
+        size2 = jit_fn._cache_size()
+        if size2 > size1:
+            return self._result(
+                program_name, ok=False,
+                details=f"cache miss on identical-aval re-dispatch "
+                        f"(entries {size1} -> {size2}): argument "
+                        f"construction churns weak_type/dtype/sharding",
+                cache=(size0, size1, size2))
+        return self._result(program_name, ok=True,
+                            details=f"cache stable at {size1} entries",
+                            cache=(size0, size1, size2))
+
+    def run(self, program) -> PassResult:
+        return self._check_fn(program.name, program.jit_fn, program.args_fn)
+
+    def selftest(self) -> PassResult:
+        f = jax.jit(lambda x: x * 2.0)
+        calls = iter((lambda: (jnp.float32(1.0),),   # strong f32 scalar
+                      lambda: (1.0,)))               # weak python float
+
+        def churny_args():
+            return next(calls)()
+
+        return self._check_fn("selftest[churn]", f, churny_args)
+
+
+# ---------------------------------------------------------- transfer guard
+
+class TransferGuardPass(AuditPass):
+    """Run the hot path under jax.transfer_guard("disallow"): any IMPLICIT
+    device transfer (a raw numpy array flowing into a jitted call, a python
+    scalar promoted mid-graph) fails. Intentional readbacks declare
+    themselves with telemetry.host_readback(reason) — the allowlist — so a
+    clean run passes by declaration, not path-string exemption. Arguments
+    are materialized OUTSIDE the guard: explicit staging is the sanctioned
+    pattern, and device_put/jnp.asarray remain allowed inside too."""
+
+    name = "transfer_guard"
+
+    def _check_workload(self, program_name: str, workload) -> PassResult:
+        try:
+            with jax.transfer_guard("disallow"):
+                jax.block_until_ready(workload())
+        except Exception as e:
+            msg = str(e)
+            if "transfer" in msg.lower():
+                return self._result(
+                    program_name, ok=False,
+                    details="implicit transfer on the hot path: "
+                            + msg.splitlines()[0][:120],
+                    error=msg[:400])
+            raise
+        return self._result(program_name, ok=True,
+                            details="clean under transfer_guard(disallow)")
+
+    def run(self, program) -> PassResult:
+        if program.workload is not None:
+            return self._check_workload(program.name, program.workload)
+        args = program.args_fn()  # staged before the guard closes
+        return self._check_workload(
+            program.name, lambda: program.jit_fn(*args))
+
+    def selftest(self) -> PassResult:
+        f = jax.jit(lambda x: x + 1.0)
+        host_arr = np.ones((4,), np.float32)
+        # raw numpy jit argument = implicit h2d — the seeded violation
+        return self._check_workload("selftest[transfer]",
+                                    lambda: f(host_arr))
+
+
+# --------------------------------------------------------------- donation
+
+class DonationPass(AuditPass):
+    """Donated argument buffers must actually be consumed: after one
+    dispatch, every donated jax.Array leaf is deleted and no
+    donation-dropped warning fired. A dropped donation silently doubles
+    the train step's peak memory — exactly the class of regression that
+    only shows up as an OOM at the flagship shape."""
+
+    name = "donation"
+
+    def applies_to(self, program) -> bool:
+        return bool(program.donate_argnums)
+
+    def _check_call(self, program_name: str, jit_fn, args,
+                    donate_argnums) -> PassResult:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            out = jit_fn(*args)
+            jax.block_until_ready(out)
+        dropped_warn = [str(w.message) for w in caught
+                        if "donated" in str(w.message).lower()]
+        undeleted = []
+        for argnum in donate_argnums:
+            for leaf in jax.tree_util.tree_leaves(args[argnum]):
+                if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                    undeleted.append((argnum, leaf.shape, str(leaf.dtype)))
+        if dropped_warn or undeleted:
+            bits = []
+            if dropped_warn:
+                bits.append("donation-dropped warning: "
+                            + dropped_warn[0][:100])
+            if undeleted:
+                bits.append(f"{len(undeleted)} donated buffers NOT "
+                            f"deleted, e.g. {undeleted[0]}")
+            return self._result(program_name, ok=False,
+                                details="; ".join(bits),
+                                undeleted=len(undeleted),
+                                warnings=dropped_warn[:3])
+        n = sum(len(jax.tree_util.tree_leaves(args[a]))
+                for a in donate_argnums)
+        return self._result(program_name, ok=True,
+                            details=f"all {n} donated buffers consumed",
+                            leaves=n)
+
+    def run(self, program) -> PassResult:
+        return self._check_call(program.name, program.jit_fn,
+                                program.args_fn(), program.donate_argnums)
+
+    def selftest(self) -> PassResult:
+        # scalar output matches no input shape -> donation dropped
+        f = jax.jit(lambda x: jnp.sum(x), donate_argnums=(0,))
+        args = (jnp.ones((16, 16), jnp.float32),)
+        return self._check_call("selftest[donation]", f, args, (0,))
+
+
+# ------------------------------------------------------------- concurrency
+
+class ConcurrencyPass(AuditPass):
+    """Host-side concurrency lint over a LIVE threaded serve workload:
+    concurrent submitters + the ContinuousBatcher flush thread + the ops
+    endpoint's handler threads + full-rate tracing, all crossing the
+    instrumented telemetry locks. Fails on any recorded lock-order
+    violation (mine_tpu/analysis/locks.py holds the global order) or on a
+    thread that survives close() — the unjoined-thread regression the
+    PR-8 close() fix addressed."""
+
+    name = "concurrency"
+    scope = "global"
+
+    N_SUBMITTERS = 3
+    N_REQUESTS = 8  # per submitter
+
+    def run_global(self) -> PassResult:
+        import urllib.request
+
+        from mine_tpu.serve.batcher import ContinuousBatcher
+        from mine_tpu.serve.engine import RenderEngine
+        from mine_tpu.telemetry import OpsServer, tracing
+        from mine_tpu.telemetry.slo import SLOTracker
+
+        baseline_threads = set(threading.enumerate())
+        _locks.violations(clear=True)
+
+        rng = np.random.RandomState(3)
+        S, H, W = 2, 16, 16
+        engine = RenderEngine(max_bucket=4)
+        engine.put("scene", rng.rand(S, 3, H, W).astype(np.float32),
+                   rng.rand(S, 1, H, W).astype(np.float32),
+                   np.linspace(1.0, 0.2, S, dtype=np.float32),
+                   np.asarray([[W, 0, W / 2], [0, H, H / 2], [0, 0, 1]],
+                              np.float32))
+        slo = SLOTracker(objective_ms=60_000.0)
+        tracing.configure(sample=1.0)
+        batcher = ContinuousBatcher(engine, max_requests=4, max_wait_ms=1.0,
+                                    start=True, slo=slo)
+        ops = OpsServer(slo=slo).start()
+        pose = np.eye(4, dtype=np.float32)
+        errors: List[str] = []
+
+        def submitter(k: int) -> None:
+            futs = [batcher.submit("scene", pose)
+                    for _ in range(self.N_REQUESTS)]
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except Exception as e:  # pragma: no cover - device failure
+                    errors.append(f"submitter {k}: {e}")
+
+        threads = [threading.Thread(target=submitter, args=(k,))
+                   for k in range(self.N_SUBMITTERS)]
+        try:
+            for t in threads:
+                t.start()
+            # ops endpoint traffic concurrently with the render threads:
+            # handler threads walk the registry + slo + trace-ring locks
+            for path in ("/metrics", "/slo", "/traces/recent", "/healthz"):
+                urllib.request.urlopen(ops.url + path, timeout=10).read()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            closed = batcher.close()
+            ops.close()
+            tracing.configure(sample=0.0)
+            tracing.reset()
+
+        time.sleep(0.05)  # give joined threads a beat to leave enumerate()
+        viol = _locks.violations(clear=True)
+        leaked = _locks.leaked_threads(baseline=baseline_threads)
+        problems = []
+        if errors:
+            problems.append(f"{len(errors)} request errors "
+                            f"({errors[0][:80]})")
+        if not closed:
+            problems.append("batcher.close() failed to join flush thread")
+        if viol:
+            v = viol[0]
+            problems.append(
+                f"{len(viol)} lock-order violations, e.g. {v['thread']} "
+                f"acquired {v['acquiring']} (rank {v['acquiring_rank']}) "
+                f"while holding {v['held']}")
+        if leaked:
+            problems.append("leaked threads: "
+                            + ", ".join(t.name for t in leaked))
+        if problems:
+            return self._result("-", ok=False, details="; ".join(problems),
+                                violations=viol[:5],
+                                leaked=[t.name for t in leaked])
+        total = self.N_SUBMITTERS * self.N_REQUESTS
+        return self._result(
+            "-", ok=True,
+            details=f"{total} requests over {self.N_SUBMITTERS} threads: "
+                    f"lock order clean, no leaked threads")
+
+    def selftest(self) -> PassResult:
+        # seeded lock-order inversion: acquire rank 2 then rank 1
+        _locks.violations(clear=True)
+        hi = _locks.OrderedLock("selftest.hi", rank=2)
+        lo = _locks.OrderedLock("selftest.lo", rank=1)
+        with hi:
+            with lo:
+                pass
+        viol = _locks.violations(clear=True)
+        ours = [v for v in viol if v["acquiring"] == "selftest.lo"]
+        if ours:
+            v = ours[0]
+            return self._result(
+                "selftest[lock-order]", ok=False,
+                details=f"lock-order inversion detected: acquired "
+                        f"{v['acquiring']} (rank {v['acquiring_rank']}) "
+                        f"while holding {v['held']}",
+                violations=ours)
+        # the monitor MISSED the inversion — selftest must surface that as
+        # a (wrongly) passing result so --selftest fails loudly
+        return self._result("selftest[lock-order]", ok=True,
+                            details="monitor failed to record inversion")
+
+
+# ---------------------------------------------------------------- suites
+
+def default_passes(baseline: Dict) -> List[AuditPass]:
+    return [DtypeUpcastPass(), DotBudgetPass(baseline),
+            RecompileChurnPass(), TransferGuardPass(), DonationPass(),
+            ConcurrencyPass()]
+
+
+def pass_by_name(name: str, baseline: Optional[Dict] = None) -> AuditPass:
+    for p in default_passes(baseline or {"programs": {}, "budgets": {}}):
+        if p.name == name:
+            return p
+    raise KeyError(f"unknown pass {name!r}")

@@ -32,6 +32,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from mine_tpu import telemetry
+from mine_tpu.analysis.locks import ordered_condition
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.telemetry import tracing
 from mine_tpu.telemetry.slo import SLOTracker
@@ -58,7 +59,7 @@ class MicroBatcher:
         # there keeps this layer from re-rolling the dice on requests the
         # fleet already declined to sample
         self.auto_trace = auto_trace
-        self._cv = threading.Condition()
+        self._cv = ordered_condition("serve.batcher.cv")
         # (image_id, pose, future, enqueue perf_counter, trace-or-None) —
         # the timestamp feeds the serve.batcher.queue_wait_ms histogram at
         # flush; the trace rides here across the submit->flush thread hop

@@ -240,7 +240,8 @@ class RenderEngine:
         t_dispatch = time.perf_counter()
         rgb, depth = self._render(*args, warp_impl)
         self.device_calls += 1
-        out = np.asarray(rgb[:P]), np.asarray(depth[:P])  # device sync
+        with telemetry.host_readback("serve.render_fetch"):  # device sync
+            out = np.asarray(rgb[:P]), np.asarray(depth[:P])
         t_end = time.perf_counter()
         elapsed_ms = (t_end - t0) * 1e3
         bucket = (Rb, Pb, warp_impl, str(planes.dtype))

@@ -23,6 +23,8 @@ coherent, parseable surface:
                shared parser
   profiler.py  opt-in jax.profiler trace windows over exact train-loop step
                ranges (telemetry.profile_steps = [start, stop])
+  hostsync.py  host_readback(reason): declared device->host syncs — the
+               transfer-guard sanitizer's allowlist (tools/audit.py)
 
 Dependency-free (stdlib only) and strictly host-side: nothing in here is
 ever traced, so instrumentation cannot change jitted numerics or add a
@@ -35,6 +37,7 @@ from mine_tpu.telemetry.events import (KIND_FIELDS, emit, ensure_configured,
                                        validate_file, validate_line)
 from mine_tpu.telemetry.export import (OpsServer, parse_prometheus,
                                        render_prometheus)
+from mine_tpu.telemetry.hostsync import host_readback, readback_counts
 from mine_tpu.telemetry.profiler import ProfileWindow
 from mine_tpu.telemetry.registry import (REGISTRY, Counter, Gauge, Histogram,
                                          MetricsRegistry, counter,
@@ -52,7 +55,7 @@ __all__ = [
     "MetricsRegistry", "ProfileWindow", "SLOTracker", "TraceContext",
     "STEP_KEYS", "STEP_SCHEMA", "TIME_KEYS", "counter", "current_span_path",
     "default_latency_buckets_ms", "emit", "ensure_configured",
-    "format_step_line", "gauge", "histogram", "parse_line", "parse_lines",
-    "parse_prometheus", "pow2_buckets", "render_prometheus", "span",
-    "tracing", "validate_file", "validate_line",
+    "format_step_line", "gauge", "histogram", "host_readback", "parse_line",
+    "parse_lines", "parse_prometheus", "pow2_buckets", "readback_counts",
+    "render_prometheus", "span", "tracing", "validate_file", "validate_line",
 ]

@@ -27,9 +27,10 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 from typing import Dict, List, Optional
+
+from mine_tpu.analysis.locks import ordered_lock
 
 SCHEMA = "mtpu-ev1"
 REQUIRED_FIELDS = ("schema", "ts", "kind")
@@ -49,7 +50,7 @@ class EventSink:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.events.sink")
         self._file = None
         self._broken = False
         self.emitted = 0
@@ -107,7 +108,9 @@ def _jsonify(v):
     return str(v)
 
 
-_state_lock = threading.Lock()
+# configure() closes the old sink while holding this — the one sanctioned
+# nesting (state rank 60 < sink rank 70 in analysis.locks.LOCK_RANKS)
+_state_lock = ordered_lock("telemetry.events.state")
 _sink: Optional[EventSink] = None
 _env_checked = False
 

@@ -25,8 +25,9 @@ holds the catalog.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from mine_tpu.analysis.locks import ordered_lock
 
 
 def default_latency_buckets_ms() -> Tuple[float, ...]:
@@ -61,7 +62,7 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.registry.metric")
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -84,7 +85,7 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.registry.metric")
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -119,7 +120,7 @@ class Histogram:
         if list(self.edges) != sorted(self.edges) or len(self.edges) < 1:
             raise ValueError(f"histogram {name}: edges must ascend, "
                              f"got {self.edges[:4]}...")
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.registry.metric")
         self._counts = [0] * (len(self.edges) + 1)  # +1 overflow
         self._count = 0
         self._sum = 0.0
@@ -216,7 +217,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.registry.registry")
         self._metrics: Dict[str, object] = {}
 
     def _get_or_create(self, name: str, cls, *args):

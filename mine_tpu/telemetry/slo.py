@@ -28,11 +28,11 @@ while the ops endpoint snapshots). `now` is injectable for tests.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Dict, Optional
 
+from mine_tpu.analysis.locks import ordered_lock
 from mine_tpu.telemetry import events as _events
 from mine_tpu.telemetry import registry as _registry
 
@@ -74,7 +74,7 @@ class SLOTracker:
         self.window_s = float(window_s)
         self.max_samples = int(max_samples)
         self.metric_prefix = metric_prefix
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.slo")
         # (t_monotonic, latency_ms, bucket) — bounded twice: by age
         # (window_s, pruned on every record/snapshot) and by count
         # (max_samples, the deque's maxlen)

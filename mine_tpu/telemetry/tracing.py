@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from mine_tpu.analysis.locks import ordered_lock
 from mine_tpu.telemetry import events as _events
 from mine_tpu.telemetry import registry as _registry
 
@@ -71,7 +72,7 @@ class TraceContext:
         self.fields = dict(fields)
         self.ts = time.time()           # wall clock, for the recent() view
         self._t0 = time.perf_counter()  # monotonic origin for t_off_ms
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.tracing.ctx")
         self.spans: List[Dict] = []
         self.finished = False
         self.total_ms: Optional[float] = None
@@ -144,7 +145,7 @@ class _Tracer:
     """Process-wide tracer state: sampling rate + completed-trace ring."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("telemetry.tracing.tracer")
         self.sample = 0.0
         self._rng = random.Random()
         self._recent: deque = deque(maxlen=DEFAULT_RECENT)

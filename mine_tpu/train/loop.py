@@ -311,9 +311,10 @@ class TrainLoop:
                 # host syncs the same two scalars and reaches the same
                 # verdict (raising on the lead only would deadlock the
                 # others in the next collective)
-                gm = {k: float(metrics[k])
-                      for k in ("skipped_steps", "guard_consecutive",
-                                "guard_last_bad_step") if k in metrics}
+                with telemetry.host_readback("train.guard_monitor"):
+                    gm = {k: float(metrics[k])
+                          for k in ("skipped_steps", "guard_consecutive",
+                                    "guard_last_bad_step") if k in metrics}
                 try:
                     self.guard_monitor.check(gm, gstep)
                 except resilience.GuardAbort:
@@ -326,7 +327,8 @@ class TrainLoop:
                     raise
 
             if at_log and self.is_lead:
-                m = metrics_to_float(metrics)  # device sync, log steps only
+                with telemetry.host_readback("train.log_metrics"):
+                    m = metrics_to_float(metrics)  # device sync, log steps only
                 dt = (time.perf_counter() - t_last) / steps_since_log
                 times = {
                     "step_ms": dt * 1e3,
@@ -438,7 +440,8 @@ class TrainLoop:
                 batch = self.trainer.put_batch(np_batch)
                 metrics, visuals = self.trainer.eval_step(
                     state, batch, jax.random.fold_in(eval_rng, i))
-            m = metrics_to_float(metrics)
+            with telemetry.host_readback("eval.metrics"):
+                m = metrics_to_float(metrics)
             for k, meter in self.val_meters.items():
                 meter.update(m[k], n=global_bs)
             if i == 0 and self.tb is not None:
@@ -472,7 +475,8 @@ class TrainLoop:
                 metrics = self.trainer.eval_step_masked(
                     state, batch,
                     jax.random.fold_in(eval_rng, 1_000_000 + j), weight)
-            m = metrics_to_float(metrics)
+            with telemetry.host_readback("eval.metrics"):
+                m = metrics_to_float(metrics)
             # valid examples in THIS tail batch across all hosts
             # (deterministic from the shard counts)
             g_valid = sum(min(max(c - j * lbs, 0), lbs)
@@ -625,7 +629,12 @@ class TrainLoop:
 
     def _log_val_images(self, gstep, batch, visuals):
         """Tensorboard image grids (synthesis_task.log_val :509-548);
-        non-fatal — see _tb."""
+        non-fatal — see _tb. Declared readback: whole image tensors come
+        to host here, once per eval."""
+        with telemetry.host_readback("eval.val_images"):
+            self._log_val_images_inner(gstep, batch, visuals)
+
+    def _log_val_images_inner(self, gstep, batch, visuals):
         def grid(x_bchw):
             x = np.asarray(x_bchw)
             return np.clip(np.concatenate(list(x), axis=2), 0.0, 1.0)

@@ -78,6 +78,7 @@ QUICK = {
     "test_warp_vjp.py::test_domain_check_classifies",
     "test_quick_tier.py::test_quick_entries_point_at_existing_tests",
     "test_quick_tier.py::test_quick_tier_covers_most_suites",
+    "test_analysis.py::test_lock_order_monitor_records_inversion",
     "test_make_scene.py::test_rotmat2qvec_roundtrip",
     "test_packed_decoder.py::test_depth_to_space_layout",
     "test_release_replica.py::test_convert_resnet50_release_covers_full_model",
@@ -141,6 +142,7 @@ def pytest_configure(config):
 # minute at the tail rather than ~10. Order within each group stays
 # alphabetical (deterministic; `-p no:randomly` is part of the contract).
 HEAVY_LAST_FILES = (
+    "test_analysis.py",
     "test_fused_loss.py",
     "test_checkpoint.py",
     "test_chaos.py",
@@ -151,6 +153,35 @@ HEAVY_LAST_FILES = (
     "test_train.py",
     "test_train_variants.py",
 )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Thread-leak tripwire: fail the session if threads the suite should
+    have joined survive teardown — a non-daemon thread (would hang the
+    interpreter), or an alive serve-plane daemon (ContinuousBatcher flush /
+    OpsServer: both have explicit close() paths, so one still alive means a
+    test forgot to close — the unjoined-thread regression the PR-8 close()
+    fix addressed). Pipeline prefetch/assembler daemons may legitimately
+    linger on queue ops and are not counted (mine_tpu.analysis.locks
+    defines the owned-name policy; the concurrency audit pass applies the
+    same check to its live workload)."""
+    import threading
+    import time
+
+    from mine_tpu.analysis.locks import leaked_threads
+
+    deadline = time.monotonic() + 5.0  # grace for join()s racing teardown
+    leaked = leaked_threads()
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.2)
+        leaked = leaked_threads()
+    if leaked:
+        names = ", ".join(f"{t.name} (daemon={t.daemon})" for t in leaked)
+        session.exitstatus = 1
+        raise RuntimeError(
+            f"thread-leak tripwire: {len(leaked)} thread(s) survived the "
+            f"test session: {names} — some test started a batcher/ops "
+            f"server (or other non-daemon thread) without close()/join()")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -406,39 +406,16 @@ def test_ssim_pairs_matches_separate_calls():
 # the dispatch-count acceptance criterion
 # ---------------------------------------------------------------------------
 
-def _iter_eqns(jaxpr):
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
-                inner = getattr(sub, "jaxpr", sub)
-                if hasattr(inner, "eqns"):
-                    yield from _iter_eqns(inner)
-
-
-def _count_blur_dots(closed_jaxpr, sizes=(64, 32, 16, 8)):
-    """dot_generals attributable to SSIM blurs: a Toeplitz blur einsum is
-    the only contraction in the loss graph whose operand is a square 2-D
-    matrix sized like a pyramid level (everything else contracts [B,3,3]
-    intrinsics-style batches or non-square grids)."""
-    n = 0
-    for eqn in _iter_eqns(closed_jaxpr.jaxpr):
-        if eqn.primitive.name != "dot_general":
-            continue
-        for var in eqn.invars:
-            shape = var.aval.shape
-            if (len(shape) == 2 and shape[0] == shape[1]
-                    and shape[0] in sizes):
-                n += 1
-                break
-    return n
-
-
 def test_blur_einsum_count_drops_4x(tiny_setup):
     """ISSUE acceptance: blur-einsum count in the jitted loss jaxpr drops
     >=4x. The fused pass runs 2 Toeplitz einsums per scale (8 total) where
     the per-scale reference ran 2 ssim calls x 5 operands x 2 einsums = 20
-    per scale (80 total) — a 10x drop."""
+    per scale (80 total) — a 10x drop. The counts are budget entries in
+    tools/analysis_baseline.json (ONE source of truth, shared with the
+    dot_budget audit pass) and counted by the shared analysis helper."""
+    from mine_tpu.analysis.flops import count_blur_dots
+    from mine_tpu.analysis.framework import load_baseline
+
     trainer, _, batch = tiny_setup
     cfg = trainer.cfg
     B, S = 2, 4
@@ -453,10 +430,11 @@ def test_blur_einsum_count_drops_4x(tiny_setup):
         lambda m, d, bt: _ref_compute_losses(m, d, bt, cfg)[0])(
             mpi_list, disparity, batch)
 
-    n_fused = _count_blur_dots(fused)
-    n_ref = _count_blur_dots(ref)
-    assert n_fused == 8, n_fused     # 2 einsums x 4 scales
-    assert n_ref == 80, n_ref        # 20 einsums x 4 scales
+    budgets = load_baseline()["budgets"]
+    n_fused = count_blur_dots(fused)
+    n_ref = count_blur_dots(ref)
+    assert n_fused == budgets["fused_loss.blur_dots"], n_fused
+    assert n_ref == budgets["fused_loss.blur_dots_reference"], n_ref
     assert n_fused * 4 <= n_ref
 
 

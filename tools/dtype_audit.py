@@ -12,9 +12,10 @@ element counts so the expensive ones sort first, and a separate "conv-stack"
 section for the converts that sit inside encoder/decoder scopes — those are
 the ones worth chasing.
 
-Known-benign scope patterns are annotated inline (column `why`) so a clean
-report is readable at a glance: anything un-annotated inside a conv scope
-is a real suspect.
+The collection/report logic lives in mine_tpu/analysis/dtype.py now, where
+the dtype-upcast audit pass (tools/audit.py) runs it over EVERY registered
+program and FAILS on unjustified conv-stack upcasts; this CLI remains the
+human-readable ranked report over the train step, output unchanged.
 
 Usage:
   python tools/dtype_audit.py                  # north-star bench shape
@@ -30,153 +31,23 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# convert ops in StableHLO text:
-#   %5 = stablehlo.convert %4 : (tensor<2x64x96x256xbf16>) -> tensor<...xf32> loc(#loc123)
-_CONVERT_RE = re.compile(
-    r"stablehlo\.convert\s+%[\w.#]+\s*:\s*"
-    r"\(tensor<([0-9x]*?)x?bf16>\)\s*->\s*tensor<[0-9x]*?x?f32>"
-    r"(?:\s+loc\((#?\w+|\"[^\"]*\".*?)\))?")
-# location table entries at the bottom of a debug_info=True module:
-#   #loc123 = loc("jit(_train_step_impl)/convert_element_type"(#loc7))
-_LOCDEF_RE = re.compile(r"^(#\w+)\s*=\s*loc\((.*)\)\s*$", re.M)
-_LOCNAME_RE = re.compile(r"\"([^\"]+)\"")
-
-# scope substrings whose bf16->f32 converts are expected and justified —
-# annotated in the report, never counted as conv-stack suspects
-JUSTIFIED = (
-    ("batch_norm", "f32 BN statistics (SyncBN numerics)"),
-    ("/bn", "f32 BN statistics (SyncBN numerics)"),
-    ("_bn", "f32 BN statistics (SyncBN numerics)"),
-    ("loss", "loss graph is f32 by design"),
-    ("ssim", "loss graph is f32 by design"),
-    ("adam", "f32 optimizer math"),
-    ("opt", "f32 optimizer math"),
-    ("transpose(jvp", "autodiff of an f32 region"),
-    # the decoder module's OWN top-level convert (not one inside a sublayer):
-    # the final [S,H,W,4] mpi outputs widening into the f32 loss graph
-    ("decoder/convert_element_type", "decoder output -> f32 loss boundary"),
-)
-
-
-def _elements(shape_str: str) -> int:
-    n = 1
-    for d in shape_str.split("x"):
-        if d:
-            n *= int(d)
-    return n
-
-
-def _loc_names(text: str):
-    """#locN -> innermost quoted name (resolving one level of nesting)."""
-    raw = dict(_LOCDEF_RE.findall(text))
-    names = {}
-    for key, body in raw.items():
-        m = _LOCNAME_RE.search(body)
-        if m is None:  # alias like #loc5 = loc(#loc3)
-            ref = re.search(r"#\w+", body)
-            body2 = raw.get(ref.group(0), "") if ref else ""
-            m = _LOCNAME_RE.search(body2)
-        names[key] = m.group(1) if m else "?"
-    return names
-
-
-def collect_upcasts(stablehlo_text: str):
-    """All bf16->f32 converts in a StableHLO module.
-
-    Returns a list of dicts {shape: str, elements: int, scope: str}; scope
-    is the jax name-stack string when the module was lowered with
-    debug_info=True, else "?".
-    """
-    loc_names = _loc_names(stablehlo_text)
-    out = []
-    for m in _CONVERT_RE.finditer(stablehlo_text):
-        shape, loc = m.group(1), m.group(2)
-        if loc is None:
-            scope = "?"
-        elif loc.startswith("#"):
-            scope = loc_names.get(loc, "?")
-        else:
-            nm = _LOCNAME_RE.search(loc)
-            scope = nm.group(1) if nm else "?"
-        # drop the shared jit(...)/jit(main)/ prefix — pure column noise
-        scope = re.sub(r"^(jit\([^)]*\)/)+", "", scope)
-        out.append({"shape": shape or "scalar",
-                    "elements": _elements(shape),
-                    "scope": scope})
-    return out
-
-
-def _justification(scope: str):
-    s = scope.lower()
-    for pat, why in JUSTIFIED:
-        if pat in s:
-            return why
-    return ""
-
-
-_CONV_STACK_RE = re.compile(r"conv(?!ert)|resnet|decoder|encoder")
-
-
-def in_conv_stack(scope: str) -> bool:
-    """Scopes inside the encoder/decoder conv stacks (the model forward),
-    where a widening convert means bf16 discipline was lost. `conv(?!ert)`:
-    every convert op's own scope component spells "convert_element_type",
-    which must not read as a conv layer."""
-    return _CONV_STACK_RE.search(scope.lower()) is not None
-
-
-def summarize(upcasts, top: int = 25) -> str:
-    if not upcasts:
-        return ("no bf16->f32 converts found "
-                "(f32-only program, or bf16 never widened)")
-    groups = {}
-    for u in upcasts:
-        key = (u["scope"], u["shape"])
-        g = groups.setdefault(key, {"count": 0, "elements": 0})
-        g["count"] += 1
-        g["elements"] += u["elements"]
-    rows = sorted(groups.items(), key=lambda kv: -kv[1]["elements"])
-    total_el = sum(u["elements"] for u in upcasts)
-    out = ["bf16 -> f32 convert_element_type report: %d converts, %.2f M "
-           "elements total" % (len(upcasts), total_el / 1e6),
-           "  %-12s %6s %10s  %-40s %s"
-           % ("shape", "count", "elements", "scope", "why")]
-    for (scope, shape), g in rows[:top]:
-        out.append("  %-12s %6d %10d  %-40s %s"
-                   % (shape[:12], g["count"], g["elements"], scope[:40],
-                      _justification(scope)))
-    if len(rows) > top:
-        out.append("  ... %d more groups (--top to widen)" % (len(rows) - top))
-
-    suspects = [u for u in upcasts
-                if in_conv_stack(u["scope"]) and not _justification(u["scope"])]
-    if suspects:
-        el = sum(u["elements"] for u in suspects)
-        out.append("CONV-STACK SUSPECTS: %d converts / %.2f M elements widen "
-                   "bf16 activations inside encoder/decoder scopes — chase "
-                   "these first" % (len(suspects), el / 1e6))
-    else:
-        out.append("conv-stack: clean (every convert is outside the "
-                   "encoder/decoder scopes or justified)")
-    return "\n".join(out)
+# the analysis module is the single source of truth; these re-exports keep
+# every pre-framework import site (tests/test_fused_loss.py's synthetic-HLO
+# fixtures among them) working unchanged
+from mine_tpu.analysis.dtype import (  # noqa: E402,F401
+    _CONVERT_RE, _LOCDEF_RE, _LOCNAME_RE, JUSTIFIED, _elements, _loc_names,
+    collect_upcasts, in_conv_stack, stablehlo_text, summarize)
+from mine_tpu.analysis.dtype import justification as _justification  # noqa: E402,F401
 
 
 def audit_trainer(trainer, state, batch):
     """bf16->f32 upcast list for one trainer's jitted train step."""
     lowered = trainer._train_step.lower(state, batch)
-    try:
-        # the MLIR asm printer is the one path that emits the loc table
-        # (name-stack scopes) on this jax version; Lowered.as_text() drops it
-        text = lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
-            enable_debug_info=True, large_elements_limit=8)
-    except Exception:  # pragma: no cover - fallback: converts still counted,
-        text = lowered.as_text()  # but every scope reads "?"
-    return collect_upcasts(text)
+    return collect_upcasts(stablehlo_text(lowered))
 
 
 def build_trainer(height, width, planes, layers, batch_size, dtype,

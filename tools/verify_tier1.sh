@@ -63,6 +63,19 @@ if [ -f "$EVENTS" ]; then
     fi
 fi
 
+# the program auditor is part of tier-1: every registered jitted program
+# must hold its dtype/budget/churn/transfer/donation/concurrency contracts
+# (tools/analysis_baseline.json is the budget source of truth; bump it via
+# `tools/audit.py --update-baseline` in the same commit as the intentional
+# program change, with a CHANGES.md line saying why)
+if ! timeout -k 10 600 python tools/audit.py --gate \
+        > /tmp/_t1_audit.txt 2>&1; then
+    tail -20 /tmp/_t1_audit.txt
+    echo "AUDIT: tools/audit.py --gate failed (full report in" \
+         "/tmp/_t1_audit.txt)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # 'X' (xpass) joins the dot classes so an xpassing line can't silently
 # swallow its neighbors' dots from the count
 passed=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)

@@ -717,6 +717,10 @@ def _measure_serve_amortize(name, steps=MEASURE_STEPS, keep_run=False):
 # offered-rate sweep of the SLO row, as fractions of the measured
 # closed-loop base throughput: below / at / past the capacity knee
 SERVE_SLO_RATE_FRACS = (0.25, 0.5, 0.75, 1.0, 1.25)
+# the deliberate overload point: offered rate past calibrated capacity,
+# replayed with admission control ON and mixed tiers — proves the shed /
+# degrade ladder engages under real queue pressure (serve/admission.py)
+SERVE_SLO_OVERLOAD_FRAC = 1.5
 
 
 def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
@@ -730,7 +734,11 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
     exactly as a real client would see it. Reported per rate: p50/p99
     latency and achieved QPS (n / last-completion); the knee is the
     highest offered rate still achieving >= 0.9x offered. Each point also
-    lands in the telemetry event stream ("serve.slo_point").
+    lands in the telemetry event stream ("serve.slo_point"). After the
+    curve, ONE deliberate overload point (SERVE_SLO_OVERLOAD_FRAC x
+    capacity) replays with admission control enabled and a tier-0 request
+    mixed in every 4th slot, printing served/shed/degraded/expired — the
+    curve itself stays admission-free so runs remain comparable.
 
     With --mesh (MINE_TPU_BENCH_MESH), the full calibrate+sweep repeats
     per fleet size through a MeshRenderEngine, printing
@@ -841,6 +849,58 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
                    default=max(pt[3] for pt in curve))
         print("  %s knee: %.2f qps (base closed-loop %.2f views/s)"
               % (tag, knee, base_qps), file=sys.stderr)
+
+        # one deliberate overload point: offered > calibrated capacity,
+        # admission ON, every 4th request best-effort (tier 0) — the
+        # controller should shed/degrade the low tier while the standard
+        # tier keeps completing (the curve above stays admission-free)
+        from mine_tpu import telemetry
+        from mine_tpu.serve.admission import (AdmissionController,
+                                              RequestShed)
+        offered = base_qps * SERVE_SLO_OVERLOAD_FRAC
+        sched = np.cumsum(rng.exponential(1.0 / offered, size=n_req))
+        admission = AdmissionController(
+            enabled=True, burn_max=0.0, queue_high=max_bucket,
+            inflight_high=0, shed_factor=2.0)
+        batcher = MicroBatcher(eng, max_requests=max_bucket,
+                               max_wait_ms=2.0, admission=admission)
+        done_at = [None] * n_req
+        futs = []
+        t_start = time.perf_counter()
+        for i in range(n_req):
+            lag = sched[i] - (time.perf_counter() - t_start)
+            if lag > 0:
+                time.sleep(lag)
+            fut = batcher.submit(image_id, poses[i % max_bucket],
+                                 tier=0 if i % 4 == 0 else 1)
+            fut.add_done_callback(_cb(i))
+            futs.append(fut)
+        served = shed = 0
+        lat_ms = []
+        for i, fut in enumerate(futs):
+            try:
+                fut.result()
+                served += 1
+                lat_ms.append((done_at[i] - t_start - sched[i]) * 1e3)
+            except RequestShed:
+                shed += 1
+        batcher.close()
+        p99 = float(np.percentile(lat_ms, 99)) if lat_ms else float("nan")
+        print("  %s overload@%.2fqps: served=%d shed=%d degraded=%d "
+              "expired=%d p99=%.1fms (admission on, tier0 every 4th)"
+              % (tag, offered, served, shed, admission.degraded,
+                 batcher.expired, p99), file=sys.stderr)
+        telemetry.emit("serve.slo_point", offered_qps=round(offered, 3),
+                       p50_ms=round(float(np.percentile(lat_ms, 50)), 3)
+                       if lat_ms else None,
+                       p99_ms=round(p99, 3) if lat_ms else None,
+                       achieved_qps=round(
+                           served / max(max(d for d in done_at
+                                            if d is not None) - t_start,
+                                        1e-9), 3) if served else 0.0,
+                       n_requests=n_req, mesh=chips, overload=True,
+                       shed=shed, degraded=admission.degraded,
+                       expired=batcher.expired)
         return knee, base_qps
 
     knee, base_qps = sweep(engine, "serve_slo", 1)

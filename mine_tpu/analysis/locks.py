@@ -28,7 +28,14 @@ from typing import Dict, List, Optional
 # The global acquisition order (ascending = allowed nesting direction).
 # Adding a lock: pick a rank consistent with every path that can hold it
 # together with another instrumented lock, and note the path here.
-#   batcher.cv        held only around queue list ops; never over telemetry
+#   batcher.cv        held around queue list ops + the admission decision,
+#                     whose edge events nest ASCENDING into telemetry
+#   fleet.cache       guards the shard list / dead set across route, put,
+#                     rebalance and failover; the per-shard LRU counters and
+#                     the shard_dead/place events nest ascending under it.
+#                     Never held together with batcher.cv (routing happens
+#                     before submit; the flush thread holds neither), so its
+#                     rank only needs to sit below telemetry.
 #   tracing ctx       add_span/finish take it, release, then emit events
 #   tracing tracer    start/finish take it alone or after ctx released
 #   slo               record() releases it before setting registry gauges
@@ -37,6 +44,7 @@ from typing import Dict, List, Optional
 #                       — the one genuine nesting, hence state < sink
 LOCK_RANKS: Dict[str, int] = {
     "serve.batcher.cv": 10,
+    "serve.fleet.cache": 15,
     "telemetry.tracing.ctx": 20,
     "telemetry.tracing.tracer": 30,
     "telemetry.slo": 40,

@@ -247,6 +247,32 @@ class ServeConfig:
     slo_objective_ms: float = 0.0
     slo_target: float = 0.99
     slo_window_s: float = 60.0
+    # serve.default_tier: priority class for requests that don't name one
+    # (0 best-effort, 1 standard, >= 2 critical; serve/admission.py)
+    default_tier: int = 1
+    # serve.request_deadline_ms: default end-to-end deadline — requests
+    # still queued past it are purged un-rendered and resolve to
+    # DeadlineExceeded; 0 = no deadline
+    request_deadline_ms: float = 0.0
+    # serve.encode_retries / encode_backoff_ms: bounded retry of transient
+    # sync-encode failures with exponential jittered backoff
+    # (serve/engine.py); 0 retries = fail on first error (PR-10 behavior)
+    encode_retries: int = 0
+    encode_backoff_ms: float = 10.0
+    # serve.shard_fail_threshold: consecutive placement failures that mark
+    # a cache shard dead and fail its key range over (serve/fleet.py)
+    shard_fail_threshold: int = 3
+    # serve.admission.*: load-shedding controller (serve/admission.py) —
+    # disabled by default so the serve path is bitwise-identical to the
+    # pre-admission behavior until opted in. Signals with threshold <= 0
+    # are ignored; shed_factor scales each threshold up to the shed level;
+    # hysteresis < 1 makes de-escalation sticky (no flapping).
+    admission_enabled: bool = False
+    admission_burn_max: float = 1.0
+    admission_queue_high: int = 64
+    admission_inflight_high: int = 256
+    admission_shed_factor: float = 2.0
+    admission_hysteresis: float = 0.7
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -267,6 +293,18 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         slo_objective_ms=float(g("serve.slo_objective_ms", 0.0) or 0.0),
         slo_target=float(g("serve.slo_target", 0.99)),
         slo_window_s=float(g("serve.slo_window_s", 60.0)),
+        default_tier=int(g("serve.default_tier", 1)),
+        request_deadline_ms=float(g("serve.request_deadline_ms", 0.0) or 0.0),
+        encode_retries=int(g("serve.encode_retries", 0) or 0),
+        encode_backoff_ms=float(g("serve.encode_backoff_ms", 10.0)),
+        shard_fail_threshold=int(g("serve.shard_fail_threshold", 3)),
+        admission_enabled=bool(g("serve.admission.enabled", False)),
+        admission_burn_max=float(g("serve.admission.burn_max", 1.0) or 0.0),
+        admission_queue_high=int(g("serve.admission.queue_high", 64) or 0),
+        admission_inflight_high=int(
+            g("serve.admission.inflight_high", 256) or 0),
+        admission_shed_factor=float(g("serve.admission.shed_factor", 2.0)),
+        admission_hysteresis=float(g("serve.admission.hysteresis", 0.7)),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -314,6 +352,32 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
     if out.slo_window_s <= 0:
         raise ValueError(
             f"serve.slo_window_s must be > 0, got {out.slo_window_s}")
+    if out.default_tier < 0:
+        raise ValueError(
+            f"serve.default_tier must be >= 0, got {out.default_tier}")
+    if out.request_deadline_ms < 0:
+        raise ValueError(
+            f"serve.request_deadline_ms must be >= 0, "
+            f"got {out.request_deadline_ms}")
+    if out.encode_retries < 0:
+        raise ValueError(
+            f"serve.encode_retries must be >= 0, got {out.encode_retries}")
+    if out.encode_backoff_ms < 0:
+        raise ValueError(
+            f"serve.encode_backoff_ms must be >= 0, "
+            f"got {out.encode_backoff_ms}")
+    if out.shard_fail_threshold < 1:
+        raise ValueError(
+            f"serve.shard_fail_threshold must be >= 1, "
+            f"got {out.shard_fail_threshold}")
+    if out.admission_shed_factor <= 1.0:
+        raise ValueError(
+            f"serve.admission.shed_factor must be > 1, "
+            f"got {out.admission_shed_factor}")
+    if not 0.0 < out.admission_hysteresis <= 1.0:
+        raise ValueError(
+            f"serve.admission.hysteresis must be in (0, 1], "
+            f"got {out.admission_hysteresis}")
     return out
 
 

@@ -7,13 +7,18 @@ asymmetry (README "Serving" / "Sharded serving"):
   cache.py     MPICache — LRU of quantized MPI planes under a byte budget
   engine.py    RenderEngine — shape-bucketed jitted render-only program
   batcher.py   MicroBatcher / ContinuousBatcher — request coalescing
+  admission.py AdmissionController — tiered load shedding / degradation
   shardmap.py  serving mesh ("batch","model") + MeshRenderEngine
-  fleet.py     ShardedPlaneCache (key-range partition) + ServeFleet
+  fleet.py     ShardedPlaneCache (key-range partition + failover) +
+               ServeFleet
 
 Configured by the serve.* keys (configs/params_default.yaml,
 config.ServeConfig).
 """
 
+from mine_tpu.serve.admission import (TIER_BEST_EFFORT, TIER_CRITICAL,
+                                      TIER_STANDARD, AdmissionController,
+                                      DeadlineExceeded, RequestShed)
 from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   dequantize_planes, image_id_for,
@@ -25,9 +30,11 @@ from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
                                      render_shardings)
 
 __all__ = [
-    "ContinuousBatcher", "MPICache", "MPIEntry", "MeshRenderEngine",
-    "MicroBatcher", "PyramidCache", "RenderEngine", "SERVE_BATCH_AXIS",
+    "AdmissionController", "ContinuousBatcher", "DeadlineExceeded",
+    "MPICache", "MPIEntry", "MeshRenderEngine", "MicroBatcher",
+    "PyramidCache", "RenderEngine", "RequestShed", "SERVE_BATCH_AXIS",
     "SERVE_MODEL_AXIS", "ServeFleet", "ShardedPlaneCache",
+    "TIER_BEST_EFFORT", "TIER_CRITICAL", "TIER_STANDARD",
     "dequantize_planes", "image_id_for", "make_serve_mesh", "pow2_bucket",
     "quantize_planes", "render_shardings", "shard_for_key",
 ]

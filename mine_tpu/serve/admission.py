@@ -1,0 +1,149 @@
+"""Admission control: shed or degrade low-tier requests under pressure.
+
+PR 8 gave the serving plane every *signal* a production control loop needs
+(error-budget burn, queue depth, in-flight count); this module is the first
+*actuator*. Requests carry an integer priority tier:
+
+    0  best-effort   degraded first, shed first
+    1  standard      the default; degraded only at the SHED level
+    2+ critical      never shed, never degraded
+
+and the controller collapses the pressure signals into one score — the max
+over `signal / threshold` for each configured signal (a threshold <= 0
+disables that signal) — mapped to three levels:
+
+    ok       score < 1.0               everything admits
+    degrade  1.0 <= score < shed_factor  tier-0 requests degrade
+    shed     score >= shed_factor        tier-0 sheds, tier-1 degrades
+
+Degradation is the graceful ladder (serve/batcher.py, serve/engine.py): a
+degraded request's sync encode lands at the next-cheaper cache quant and an
+all-degraded batch caps at half the pose bucket, trading fidelity and batch
+shape for survival before anything is dropped. Shedding resolves the
+request's future immediately with `RequestShed` — the caller gets a fast
+failure instead of a doomed wait.
+
+Level transitions are HYSTERETIC and edge-triggered like the SLO breach
+events: stepping down a level requires the score to fall below
+`threshold * hysteresis`, and each state change emits ONE `serve.admission`
+event (never one per request) plus the `serve.admission.state` gauge.
+
+Thread model: `decide()` is called under the batcher's queue lock (the
+queue depth it consumes is only coherent there), so the controller needs no
+lock of its own; the telemetry it touches nests ascending per
+analysis/locks.py. The burn signal reads `SLOTracker.burn` — a lock-free
+cached float — so a decision never contends with the SLO window.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from mine_tpu import telemetry
+
+TIER_BEST_EFFORT = 0
+TIER_STANDARD = 1
+TIER_CRITICAL = 2
+
+LEVELS = ("ok", "degrade", "shed")
+
+
+class RequestShed(RuntimeError):
+    """The admission controller refused this request under overload; retry
+    later or at a higher tier. Delivered through the request's future."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed while it was still queued; it was
+    purged at dispatch time, never rendered (serve/batcher.py)."""
+
+
+class AdmissionController:
+    """See module docstring. `enabled=False` (the default) makes `decide`
+    a constant "admit" — the zero-cost off state the parity tests pin."""
+
+    def __init__(self, enabled: bool = False,
+                 burn_max: float = 1.0,
+                 queue_high: int = 64,
+                 inflight_high: int = 256,
+                 shed_factor: float = 2.0,
+                 hysteresis: float = 0.7,
+                 burn_fn: Optional[Callable[[], float]] = None):
+        if shed_factor <= 1.0:
+            raise ValueError(
+                f"admission shed_factor must be > 1, got {shed_factor}")
+        if not 0.0 < hysteresis <= 1.0:
+            raise ValueError(
+                f"admission hysteresis must be in (0, 1], got {hysteresis}")
+        self.enabled = bool(enabled)
+        self.burn_max = float(burn_max)
+        self.queue_high = int(queue_high)
+        self.inflight_high = int(inflight_high)
+        self.shed_factor = float(shed_factor)
+        self.hysteresis = float(hysteresis)
+        self.burn_fn = burn_fn
+        self._level = 0
+        self.transitions = 0
+        self.shed = 0
+        self.degraded = 0
+
+    @property
+    def state(self) -> str:
+        return LEVELS[self._level]
+
+    def score(self, queue_depth: int, inflight: int) -> float:
+        """Pressure score: max over configured signals of value/threshold.
+        >= 1.0 means at least one signal crossed its line."""
+        s = 0.0
+        if self.burn_max > 0 and self.burn_fn is not None:
+            s = max(s, self.burn_fn() / self.burn_max)
+        if self.queue_high > 0:
+            s = max(s, queue_depth / self.queue_high)
+        if self.inflight_high > 0:
+            s = max(s, inflight / self.inflight_high)
+        return s
+
+    def _update_level(self, score: float, queue_depth: int,
+                      inflight: int) -> None:
+        target = (2 if score >= self.shed_factor
+                  else 1 if score >= 1.0 else 0)
+        level = self._level
+        if target > level:
+            level = target  # escalate immediately: pressure is now
+        elif target < level:
+            # de-escalate one level at a time, and only once the score has
+            # fallen clearly below the threshold being left (hysteresis):
+            # a score oscillating around a line must not flap the state
+            leaving = self.shed_factor if level == 2 else 1.0
+            if score < leaving * self.hysteresis:
+                level -= 1
+        if level != self._level:
+            prev = LEVELS[self._level]
+            self._level = level
+            self.transitions += 1
+            telemetry.gauge("serve.admission.state").set(level)
+            telemetry.emit("serve.admission", state=LEVELS[level], prev=prev,
+                           score=round(score, 4), queue_depth=queue_depth,
+                           inflight=inflight)
+
+    def decide(self, tier: int, queue_depth: int, inflight: int) -> str:
+        """-> "admit" | "degrade" | "shed" for one request. Updates the
+        pressure level first (edge-triggered event on change), then applies
+        the tier policy. Callers serialize (the batcher's queue lock)."""
+        if not self.enabled:
+            return "admit"
+        self._update_level(self.score(queue_depth, inflight),
+                           queue_depth, inflight)
+        if tier >= TIER_CRITICAL or self._level == 0:
+            return "admit"
+        if self._level == 1:
+            decision = "degrade" if tier <= TIER_BEST_EFFORT else "admit"
+        else:  # shed level
+            decision = "shed" if tier <= TIER_BEST_EFFORT else "degrade"
+        if decision == "shed":
+            self.shed += 1
+            telemetry.counter("serve.admission.shed").inc()
+        elif decision == "degrade":
+            self.degraded += 1
+            telemetry.counter("serve.admission.degraded").inc()
+        return decision

@@ -19,6 +19,18 @@ batch: a full bucket or the deadline), hands the trace to the engine for
 pad/render/encode spans, and seals the trace when the future resolves.
 An attached `slo` tracker (telemetry/slo.py) sees EVERY request's
 end-to-end latency — SLO accounting is never sampled.
+
+Self-protection (PR 11, serve/admission.py): requests carry a priority
+`tier` and an optional deadline. An attached `AdmissionController` is
+consulted at submit time under the queue lock — a shed verdict resolves the
+future immediately with `RequestShed`; a degrade verdict tags the request
+for the graceful ladder (stepped-down cache quant on a sync-encode miss,
+and an all-degraded batch caps at half the pose bucket). The flush path
+runs a DEADLINE SWEEP before selecting: already-expired requests are purged
+(future gets `DeadlineExceeded`) and never rendered. Dispatch selection is
+priority-ordered — highest tier first, FIFO within a tier — via a stable
+sort, so with every request at the default tier the order (and therefore
+the output) is bitwise-identical to the plain FIFO batcher.
 """
 
 from __future__ import annotations
@@ -27,17 +39,35 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 
 from mine_tpu import telemetry
 from mine_tpu.analysis.locks import ordered_condition
+from mine_tpu.serve.admission import (AdmissionController, DeadlineExceeded,
+                                      RequestShed)
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.telemetry import tracing
 from mine_tpu.telemetry.slo import SLOTracker
 
 _log = logging.getLogger(__name__)
+
+
+class _Pending(NamedTuple):
+    """One queued request. Field ORDER is part of the queue's informal API
+    (tests probe `_pending[0][3]` for the enqueue timestamp): the first
+    five fields are exactly the PR-5 tuple; the tail is the PR-11
+    resilience state."""
+    image_id: str
+    pose: np.ndarray
+    fut: Future
+    t_enq: float
+    trace: Optional[tracing.TraceContext]
+    tier: int = 1
+    deadline: Optional[float] = None  # perf_counter timestamp; None = none
+    degraded: bool = False
+    image: Optional[np.ndarray] = None  # sync-encode fallback pixels
 
 
 class MicroBatcher:
@@ -46,7 +76,10 @@ class MicroBatcher:
                  max_wait_ms: float = 2.0,
                  start: bool = True,
                  slo: Optional[SLOTracker] = None,
-                 auto_trace: bool = True):
+                 auto_trace: bool = True,
+                 admission: Optional[AdmissionController] = None,
+                 default_tier: int = 1,
+                 request_deadline_ms: float = 0.0):
         if max_requests < 1:
             raise ValueError(f"max_requests must be >= 1, got {max_requests}")
         self.engine = engine
@@ -59,12 +92,20 @@ class MicroBatcher:
         # there keeps this layer from re-rolling the dice on requests the
         # fleet already declined to sample
         self.auto_trace = auto_trace
+        # self-protection (serve/admission.py): None = every request admits
+        # unconditionally (the PR-10 behavior, bitwise)
+        self.admission = admission
+        self.default_tier = int(default_tier)
+        self.request_deadline_ms = float(request_deadline_ms)
+        self.expired = 0  # requests purged by the deadline sweep
+        # injectable clock (instance attr): the deadline-sweep regression
+        # test replaces it with a fake so expiry needs no real waiting
+        self._now = time.perf_counter
         self._cv = ordered_condition("serve.batcher.cv")
-        # (image_id, pose, future, enqueue perf_counter, trace-or-None) —
-        # the timestamp feeds the serve.batcher.queue_wait_ms histogram at
-        # flush; the trace rides here across the submit->flush thread hop
-        self._pending: List[Tuple[str, np.ndarray, Future, float,
-                                  Optional[tracing.TraceContext]]] = []
+        # queued-but-unresolved + dispatched-but-unresolved: the in-flight
+        # pressure signal the admission controller consumes (guarded by cv)
+        self._inflight = 0
+        self._pending: List[_Pending] = []
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -73,61 +114,137 @@ class MicroBatcher:
             self._thread.start()
 
     def submit(self, image_id: str, pose_44: np.ndarray,
-               trace: Optional[tracing.TraceContext] = None) -> Future:
+               trace: Optional[tracing.TraceContext] = None,
+               tier: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               image: Optional[np.ndarray] = None) -> Future:
         """Enqueue one view request; resolves to (rgb [3,H,W],
         depth [1,H,W]) f32 numpy. `trace` attaches an already-started
         request trace (the fleet's submit passes one that already carries
         the route span); without one, the batcher makes its own sampling
         decision (unless auto_trace is off) so a bare-batcher deployment
-        still gets traces."""
+        still gets traces.
+
+        `tier` is the request's priority class (default `default_tier`;
+        serve/admission.py); under pressure an attached controller may
+        resolve the future immediately with `RequestShed`, or tag the
+        request degraded. `deadline_ms` bounds its total queue+render time
+        (default `request_deadline_ms`; 0/None = no deadline): a request
+        still queued past its deadline is purged at dispatch time with
+        `DeadlineExceeded`. `image` optionally carries the source pixels so
+        a cache miss can fall back to the synchronous encode."""
         if trace is None and self.auto_trace:
             trace = tracing.start("serve.request", image_id=str(image_id)[:12])
+        tier = self.default_tier if tier is None else int(tier)
+        if deadline_ms is None:
+            deadline_ms = self.request_deadline_ms
         fut: Future = Future()
+        decision = "admit"
         with self._cv:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._pending.append(
-                (image_id, np.asarray(pose_44, np.float32), fut,
-                 time.perf_counter(), trace))
-            self._cv.notify()
+            if self.admission is not None:
+                decision = self.admission.decide(
+                    tier, len(self._pending), self._inflight)
+            if decision != "shed":
+                now = self._now()
+                self._pending.append(_Pending(
+                    image_id, np.asarray(pose_44, np.float32), fut, now,
+                    trace, tier,
+                    now + deadline_ms / 1e3 if deadline_ms > 0 else None,
+                    decision == "degrade", image))
+                self._inflight += 1
+                self._cv.notify()
+        if decision == "shed":
+            fut.set_exception(RequestShed(
+                f"request for {str(image_id)[:12]} shed at tier {tier} "
+                f"(admission state {self.admission.state})"))
+            tracing.finish(trace, ok=False)
         return fut
+
+    def _take_batch(self, now: float):
+        """Select the next dispatch batch (callers hold self._cv); returns
+        (batch, expired). The sweep purges already-expired requests FIRST —
+        they are never rendered; selection is then highest-tier-first, FIFO
+        within a tier (a STABLE sort: uniform tiers reproduce plain FIFO
+        exactly); an all-degraded batch caps at half the pose bucket (the
+        graceful ladder's smaller-bucket step)."""
+        expired: List[_Pending] = []
+        if any(r.deadline is not None and r.deadline <= now
+               for r in self._pending):
+            keep: List[_Pending] = []
+            for r in self._pending:
+                (expired if r.deadline is not None and r.deadline <= now
+                 else keep).append(r)
+            self._pending[:] = keep
+        if len({r.tier for r in self._pending}) > 1:
+            ranked = sorted(self._pending, key=lambda r: (-r.tier, r.t_enq))
+            batch = ranked[:self.max_requests]
+            taken = {id(r) for r in batch}
+            self._pending[:] = [r for r in self._pending
+                                if id(r) not in taken]
+        else:
+            batch = self._pending[:self.max_requests]
+            del self._pending[:len(batch)]
+        if batch and all(r.degraded for r in batch):
+            cap = max(1, self.max_requests // 2)
+            if len(batch) > cap:
+                self._pending[:0] = batch[cap:]
+                batch = batch[:cap]
+        return batch, expired
 
     def flush(self) -> int:
         """Dispatch up to max_requests pending requests in ONE device call;
-        returns how many were served (0 = nothing pending)."""
+        returns how many were served (0 = nothing pending). Requests whose
+        deadline already passed are purged here — resolved with
+        `DeadlineExceeded`, never rendered — before the batch is cut."""
         with self._cv:
-            batch = self._pending[:self.max_requests]
-            del self._pending[:len(batch)]
+            batch, expired = self._take_batch(self._now())
+            self._inflight -= len(expired)
+        if expired:
+            self.expired += len(expired)
+            telemetry.counter("serve.batcher.expired").inc(len(expired))
+            for r in expired:
+                r.fut.set_exception(DeadlineExceeded(
+                    f"request for {str(r.image_id)[:12]} expired after "
+                    f"{(self._now() - r.t_enq) * 1e3:.1f} ms in queue"))
+                tracing.finish(r.trace, ok=False)
         if not batch:
             return 0
         now = time.perf_counter()
         cause = "full" if len(batch) >= self.max_requests else "deadline"
         wait_hist = telemetry.histogram("serve.batcher.queue_wait_ms")
-        for _, _, _, t_enq, trace in batch:
-            wait_hist.record((now - t_enq) * 1e3)
-            if trace is not None:
-                trace.add_span("queue", (now - t_enq) * 1e3, t0=t_enq,
-                               flush_cause=cause, batch_size=len(batch))
+        for r in batch:
+            wait_hist.record((now - r.t_enq) * 1e3)
+            if r.trace is not None:
+                r.trace.add_span("queue", (now - r.t_enq) * 1e3, t0=r.t_enq,
+                                 flush_cause=cause, batch_size=len(batch))
         telemetry.histogram(
             "serve.batcher.coalesce_size",
             edges=telemetry.pow2_buckets(1024)).record(len(batch))
         try:
             results = self.engine.render_many(
-                [(i, p) for i, p, _, _, _ in batch],
-                traces=[t for _, _, _, _, t in batch])
+                [(r.image_id, r.pose) for r in batch],
+                traces=[r.trace for r in batch],
+                images=[r.image for r in batch],
+                degraded=[r.degraded for r in batch])
             self.flushes += 1
             done = time.perf_counter()
             bucket = pow2_bucket(len(batch))
-            for (_, _, fut, t_enq, trace), res in zip(batch, results):
-                fut.set_result(res)
+            for r, res in zip(batch, results):
+                r.fut.set_result(res)
                 if self.slo is not None:
-                    self.slo.record((done - t_enq) * 1e3, bucket=bucket)
-                tracing.finish(trace)
-        except Exception as e:  # pragma: no cover - device failures
-            for _, _, fut, _, trace in batch:
-                if not fut.done():
-                    fut.set_exception(e)
-                tracing.finish(trace, ok=False)
+                    self.slo.record((done - r.t_enq) * 1e3, bucket=bucket,
+                                    tier=r.tier)
+                tracing.finish(r.trace)
+        except Exception as e:
+            for r in batch:
+                if not r.fut.done():
+                    r.fut.set_exception(e)
+                tracing.finish(r.trace, ok=False)
+        finally:
+            with self._cv:
+                self._inflight -= len(batch)
         return len(batch)
 
     def _run(self) -> None:

@@ -138,11 +138,15 @@ class MPICache:
             mpi_rgb_S3HW: jnp.ndarray,
             mpi_sigma_S1HW: jnp.ndarray,
             disparity_S: jnp.ndarray,
-            K_33: jnp.ndarray) -> MPIEntry:
+            K_33: jnp.ndarray,
+            quant: Optional[str] = None) -> MPIEntry:
+        # `quant` overrides the cache's storage mode for THIS entry only —
+        # the degradation ladder (serve/admission.py) places a degraded
+        # request's encode at the next-cheaper mode; None keeps the default
         planes = jnp.concatenate(
             [jnp.asarray(mpi_rgb_S3HW, jnp.float32),
              jnp.asarray(mpi_sigma_S1HW, jnp.float32)], axis=1)  # [S,4,H,W]
-        stored, scales = quantize_planes(planes, self.quant)
+        stored, scales = quantize_planes(planes, quant or self.quant)
         disparity = jnp.asarray(disparity_S, jnp.float32)
         K = jnp.asarray(K_33, jnp.float32)
         entry = MPIEntry(
@@ -189,6 +193,17 @@ class MPICache:
         self.hits += 1
         telemetry.counter(self._METRIC_PREFIX + ".hits").inc()
         self._entries.move_to_end(image_id)
+        return entry
+
+    def pop(self, image_id: str) -> Optional[MPIEntry]:
+        """Remove an entry WITHOUT counting an eviction (the fleet's
+        failover remap moves it to another shard — serve/fleet.py — so it
+        stays resident somewhere; an eviction count would misread as
+        budget pressure)."""
+        entry = self._entries.pop(image_id, None)
+        if entry is not None:
+            self.nbytes -= entry.nbytes
+            _sync_cache_gauges(self)
         return entry
 
     def stats(self) -> dict:

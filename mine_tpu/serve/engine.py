@@ -26,6 +26,8 @@ planes.
 
 from __future__ import annotations
 
+import logging
+import random
 import time
 import warnings
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -38,8 +40,15 @@ import jax.numpy as jnp
 from mine_tpu import geometry, telemetry
 from mine_tpu.ops import rendering
 from mine_tpu.serve.cache import MPICache, MPIEntry, image_id_for
+from mine_tpu.testing import faults
+
+_log = logging.getLogger(__name__)
 
 _warned_sync_encode = set()
+
+# the graceful-degradation ladder's quant step-down (serve/admission.py):
+# a degraded request's sync encode lands at the next-cheaper storage mode
+DEGRADE_QUANT = {"float32": "bf16", "bf16": "int8", "int8": "int8"}
 
 
 def _warn_sync_encode(engine_key, image_id: str) -> None:
@@ -91,7 +100,9 @@ class RenderEngine:
                  warp_sep_tol: float = 0.5,
                  max_bucket: int = 8,
                  cache: Optional[MPICache] = None,
-                 encode_fn: Optional[Callable] = None):
+                 encode_fn: Optional[Callable] = None,
+                 encode_retries: int = 0,
+                 encode_backoff_ms: float = 10.0):
         if max_bucket < 1 or (max_bucket & (max_bucket - 1)) != 0:
             raise ValueError(
                 f"serve.max_bucket must be a power of two >= 1, "
@@ -109,6 +120,12 @@ class RenderEngine:
         # disparity [S], K [3,3]) — the synchronous fallback for cache
         # misses; None keeps the engine strictly render-only (miss raises)
         self.encode_fn = encode_fn
+        # bounded retry for TRANSIENT sync-encode failures (a flaky encoder
+        # or a shard placement racing failover): `encode_retries` extra
+        # attempts with jittered exponential backoff from
+        # `encode_backoff_ms`; 0 retries = fail on the first error
+        self.encode_retries = int(encode_retries)
+        self.encode_backoff_ms = float(encode_backoff_ms)
         self.device_calls = 0
         self.sync_encodes = 0
         # pose buckets never drop below this (the mesh subclass raises it
@@ -140,7 +157,8 @@ class RenderEngine:
             self.cache.put(image_id, *self.encode_fn(img_hwc))
         return image_id
 
-    def _entry(self, image_id: str, image=None, traces=()) -> MPIEntry:
+    def _entry(self, image_id: str, image=None, traces=(),
+               degraded: bool = False) -> MPIEntry:
         entry = self.cache.get(image_id)
         if entry is not None:
             return entry
@@ -148,13 +166,46 @@ class RenderEngine:
             raise KeyError(
                 f"image {image_id[:12]}… not cached and no synchronous "
                 f"encode path (pass image= and set encode_fn)")
-        _warn_sync_encode(id(self), image_id)
+        # exactly once per miss, whatever the retry loop does below — the
+        # counter's contract is "every sync encode", not "every attempt"
         self.sync_encodes += 1
         telemetry.counter("serve.sync_encode").inc()
+        quant = None
+        if degraded:
+            # degradation ladder: a degraded request's encode lands at the
+            # next-cheaper storage mode (None = already at the floor)
+            step = DEGRADE_QUANT.get(self.cache.quant)
+            quant = step if step != self.cache.quant else None
         t0 = time.perf_counter()
-        # emit=False: the span event would duplicate this richer one
+        attempts = max(0, self.encode_retries) + 1
+        # emit=False: the span event would duplicate the richer one below
         with telemetry.span("serve.sync_encode", emit=False):
-            entry = self.cache.put(image_id, *self.encode_fn(image))
+            for attempt in range(attempts):
+                try:
+                    faults.on_encode(image_id)  # chaos seam (no-op unplanned)
+                    result = self.encode_fn(image)
+                    entry = (self.cache.put(image_id, *result, quant=quant)
+                             if quant is not None
+                             else self.cache.put(image_id, *result))
+                    break
+                except Exception:
+                    if attempt + 1 >= attempts:
+                        raise
+                    telemetry.counter("serve.encode_retry").inc()
+                    # jittered exponential backoff: transient faults heal,
+                    # and concurrent retriers decorrelate
+                    delay_s = (self.encode_backoff_ms / 1e3) * (2 ** attempt)
+                    time.sleep(delay_s * (0.5 + 0.5 * random.random()))
+        if attempt:
+            # a retry recovered: the one-time warning would cry wolf about
+            # a path that self-healed — log at debug, keep the warning slot
+            # unconsumed for a genuine clean-miss slow path
+            telemetry.counter("serve.encode_retry_recovered").inc()
+            _log.debug("sync encode for %s recovered after %d retr%s",
+                       image_id[:12], attempt,
+                       "y" if attempt == 1 else "ies")
+        else:
+            _warn_sync_encode(id(self), image_id)
         encode_ms = (time.perf_counter() - t0) * 1e3
         # every traced request waiting on this entry pays the encode: the
         # span lands in each of their traces, not just the one that missed
@@ -163,7 +214,8 @@ class RenderEngine:
                 trace.add_span("encode", encode_ms, t0=t0,
                                image_id=image_id[:12], sync=True)
         telemetry.emit("serve.sync_encode", image_id=image_id[:12],
-                       total=self.sync_encodes)
+                       total=self.sync_encodes, retries=attempt,
+                       degraded=degraded)
         return entry
 
     # ---------------- jitted render ----------------
@@ -219,12 +271,21 @@ class RenderEngine:
             idx = np.concatenate([idx, np.zeros(Pb - P, idx.dtype)])
         R = len(entries)
         Rb = pow2_bucket(R)
-        planes = jnp.stack([e.planes for e in entries])
+        if len({str(e.planes.dtype) for e in entries}) > 1:
+            # degraded placements (serve/admission.py) can coalesce entries
+            # of different storage dtypes into one batch; stacking would
+            # silently promote. Widen host-side to f32 — the dequant the
+            # program would fuse anyway, so values are identical, at the
+            # cost of this one call's HBM compression
+            planes = jnp.stack([e.dequantized() for e in entries])
+            scales = None
+        else:
+            planes = jnp.stack([e.planes for e in entries])
+            scales = None
+            if entries[0].scales is not None:
+                scales = jnp.stack([e.scales for e in entries])
         disp = jnp.stack([e.disparity for e in entries])
         K = jnp.stack([e.K for e in entries])
-        scales = None
-        if entries[0].scales is not None:
-            scales = jnp.stack([e.scales for e in entries])
         if R < Rb:
             # pad by repeating entry 0: all-valid data, never gathered
             def pad_r(a):
@@ -238,6 +299,7 @@ class RenderEngine:
                            jnp.asarray(idx, jnp.int32),
                            jnp.asarray(poses, jnp.float32))
         t_dispatch = time.perf_counter()
+        faults.on_render()  # chaos seam: injected slow device (no-op unplanned)
         rgb, depth = self._render(*args, warp_impl)
         self.device_calls += 1
         with telemetry.host_readback("serve.render_fetch"):  # device sync
@@ -304,24 +366,42 @@ class RenderEngine:
 
     def render_many(self, requests: Sequence[Tuple[str, np.ndarray]],
                     warp_impl: Optional[str] = None,
-                    traces: Optional[Sequence] = None
+                    traces: Optional[Sequence] = None,
+                    images: Optional[Sequence] = None,
+                    degraded: Optional[Sequence[bool]] = None
                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Coalesced path: [(image_id, pose [4,4])...] across DISTINCT
         cached MPIs -> one device call; per-request (rgb, depth) in order.
         `traces` aligns with `requests` (None entries fine): each traced
-        request gets this dispatch's pad/render spans."""
+        request gets this dispatch's pad/render spans. `images` aligns too:
+        a request carrying its source pixels lets a cache miss fall back to
+        the synchronous encode exactly like `render(image=...)` — the
+        batcher's flush path forwards them. `degraded` (also aligned): an
+        entry whose EVERY requester is degraded encodes at the stepped-down
+        cache quant on a miss (one full-fidelity rider keeps the shared
+        entry full-fidelity)."""
         if not requests:
             return []
         if traces is None:
             traces = [None] * len(requests)
+        if images is None:
+            images = [None] * len(requests)
+        if degraded is None:
+            degraded = [False] * len(requests)
         order: List[str] = []
         for image_id, _ in requests:
             if image_id not in order:
                 order.append(image_id)
         entries = [
-            self._entry(i, traces=[t for (rid, _), t
-                                   in zip(requests, traces)
-                                   if t is not None and rid == i])
+            self._entry(i,
+                        image=next((im for (rid, _), im
+                                    in zip(requests, images)
+                                    if im is not None and rid == i), None),
+                        traces=[t for (rid, _), t
+                                in zip(requests, traces)
+                                if t is not None and rid == i],
+                        degraded=all(d for (rid, _), d
+                                     in zip(requests, degraded) if rid == i))
             for i in order]
         idx = np.asarray([order.index(i) for i, _ in requests], np.int32)
         poses = np.stack([np.asarray(p, np.float32) for _, p in requests])

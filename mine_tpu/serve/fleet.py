@@ -32,12 +32,15 @@ import time
 from typing import Callable, List, Optional
 
 from mine_tpu import telemetry
+from mine_tpu.analysis.locks import ordered_lock
+from mine_tpu.serve.admission import AdmissionController
 from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import MPICache, MPIEntry
 from mine_tpu.serve.shardmap import MeshRenderEngine
 from mine_tpu.telemetry import tracing
 from mine_tpu.telemetry.export import OpsServer
 from mine_tpu.telemetry.slo import SLOTracker
+from mine_tpu.testing import faults
 
 _METRIC_PREFIX = "serve.shard"
 # ownership uses the leading 32 bits of the content hash: wide enough that
@@ -75,14 +78,29 @@ class ShardedPlaneCache:
     Per-occurrence routing telemetry lands under `serve.shard.*`; the
     per-shard LRUs keep mirroring the process-wide `serve.cache.*`
     counters, which therefore aggregate over all shards.
+
+    Failover (PR 11): `fail_threshold` CONSECUTIVE placement failures mark
+    a shard dead — its resident entries are dropped (the failure mode being
+    modeled is the shard's memory going with it), a `serve.shard_dead`
+    event fires, and its key range re-routes ring-wise to the next alive
+    shard (`alive_owner`). `mark_alive` re-adopts a recovered shard: the
+    same entry-move loop `rebalance()` uses walks every resident entry back
+    to its true owner (`serve.shard_revive`). All shard-list / dead-set
+    state is guarded by one rank-ordered lock ("serve.fleet.cache",
+    analysis/locks.py) so routing, placement, rebalance and failover can
+    race from the submit and flush threads.
     """
 
     def __init__(self, num_shards: int = 1, capacity_bytes: int = 0,
-                 quant: str = "bf16"):
+                 quant: str = "bf16", fail_threshold: int = 3):
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if fail_threshold < 1:
+            raise ValueError(
+                f"fail_threshold must be >= 1, got {fail_threshold}")
         self.capacity_bytes = int(capacity_bytes)
         self.quant = quant
+        self.fail_threshold = int(fail_threshold)
         self.shards: List[MPICache] = [
             MPICache(capacity_bytes=self.capacity_bytes // num_shards
                      if self.capacity_bytes else 0, quant=quant)
@@ -91,91 +109,220 @@ class ShardedPlaneCache:
         self.remote_routes = 0
         self.owner_encodes = 0
         self.rebalances = 0
+        self.failovers = 0  # shards marked dead over this cache's lifetime
+        self._lock = ordered_lock("serve.fleet.cache")
+        self._dead: set = set()
+        self._fail_counts: dict = {}
 
     @property
     def num_shards(self) -> int:
         return len(self.shards)
 
+    @property
+    def dead_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead)
+
     def owner(self, image_id: str) -> int:
         return shard_for_key(image_id, self.num_shards)
 
+    def _alive_owner(self, image_id: str) -> int:
+        """True owner, or — when it is marked dead — the next alive shard
+        ring-wise (callers hold self._lock). Deterministic in (image_id,
+        num_shards, dead set), so every front-end re-routes identically."""
+        o = shard_for_key(image_id, len(self.shards))
+        if o not in self._dead:
+            return o
+        for step in range(1, len(self.shards)):
+            cand = (o + step) % len(self.shards)
+            if cand not in self._dead:
+                return cand
+        raise RuntimeError("every cache shard is marked dead")
+
+    def alive_owner(self, image_id: str) -> int:
+        with self._lock:
+            return self._alive_owner(image_id)
+
     def route(self, caller_shard: int, image_id: str) -> int:
         """Front-end routing step: the shard a request lands on forwards
-        the key to its owner; a cross-shard hop is a remote route."""
-        o = self.owner(image_id)
+        the key to its (alive) owner; a cross-shard hop is a remote
+        route."""
+        with self._lock:
+            o = self._alive_owner(image_id)
         if caller_shard != o:
             self.remote_routes += 1
             telemetry.counter(_METRIC_PREFIX + ".remote_route").inc()
         return o
 
     def __len__(self) -> int:
-        return sum(len(s) for s in self.shards)
+        with self._lock:
+            return sum(len(s) for s in self.shards)
 
     def __contains__(self, image_id: str) -> bool:
-        return image_id in self.shards[self.owner(image_id)]
+        with self._lock:
+            return image_id in self.shards[self._alive_owner(image_id)]
 
     def keys(self):
-        return [k for s in self.shards for k in s.keys()]
+        with self._lock:
+            return [k for s in self.shards for k in s.keys()]
 
     @property
     def nbytes(self) -> int:
-        return sum(s.nbytes for s in self.shards)
+        with self._lock:
+            return sum(s.nbytes for s in self.shards)
 
     def get(self, image_id: str) -> Optional[MPIEntry]:
-        entry = self.shards[self.owner(image_id)].get(image_id)
+        with self._lock:
+            entry = self.shards[self._alive_owner(image_id)].get(image_id)
         if entry is not None:
             self.owner_hits += 1
             telemetry.counter(_METRIC_PREFIX + ".owner_hit").inc()
         return entry
 
     def put(self, image_id: str, mpi_rgb_S3HW, mpi_sigma_S1HW,
-            disparity_S, K_33) -> MPIEntry:
+            disparity_S, K_33, quant: Optional[str] = None) -> MPIEntry:
         """Owner-side placement: the encode result lands on the shard that
-        owns the key's range, never on the shard the request arrived at."""
-        o = self.owner(image_id)
-        entry = self.shards[o].put(image_id, mpi_rgb_S3HW, mpi_sigma_S1HW,
-                                   disparity_S, K_33)
+        owns the key's range (ring-stepped past dead shards), never on the
+        shard the request arrived at. A placement failure counts toward the
+        owner's consecutive-failure tally (`fail_threshold` of them marks
+        it dead) and re-raises — the engine's bounded encode retry is the
+        recovery path, and its next attempt routes past the dead shard."""
+        with self._lock:
+            o = self._alive_owner(image_id)
+            try:
+                faults.on_shard_put(o)  # chaos seam (no-op unplanned)
+                entry = self.shards[o].put(
+                    image_id, mpi_rgb_S3HW, mpi_sigma_S1HW,
+                    disparity_S, K_33, quant=quant)
+            except Exception:
+                self._note_failure(o)
+                raise
+            self._fail_counts.pop(o, None)  # threshold is CONSECUTIVE
+            shards = len(self.shards)
         self.owner_encodes += 1
         telemetry.counter(_METRIC_PREFIX + ".owner_encode").inc()
         telemetry.emit("serve.shard.place", image_id=image_id[:12],
-                       shard=o, shards=self.num_shards, nbytes=entry.nbytes)
+                       shard=o, shards=shards, nbytes=entry.nbytes)
         return entry
+
+    def _note_failure(self, shard: int) -> None:
+        """One placement failure on `shard` (caller holds self._lock);
+        crossing `fail_threshold` consecutive failures marks it dead."""
+        n = self._fail_counts.get(shard, 0) + 1
+        self._fail_counts[shard] = n
+        if (n >= self.fail_threshold and shard not in self._dead
+                and len(self._dead) + 1 < len(self.shards)):
+            self._mark_dead(shard, failures=n)
+
+    def _mark_dead(self, shard: int, failures: int) -> None:
+        """Caller holds self._lock. The dead shard's residency is DROPPED
+        (its memory died with it) and its key range re-routes via
+        `_alive_owner` from this point on."""
+        dropped = len(self.shards[shard])
+        per = (self.capacity_bytes // len(self.shards)
+               if self.capacity_bytes else 0)
+        self.shards[shard] = MPICache(capacity_bytes=per, quant=self.quant)
+        self._dead.add(shard)
+        self.failovers += 1
+        telemetry.counter(_METRIC_PREFIX + ".dead_total").inc()
+        telemetry.gauge(_METRIC_PREFIX + ".dead").set(len(self._dead))
+        telemetry.emit("serve.shard_dead", shard=shard,
+                       shards=len(self.shards), failures=failures,
+                       dropped=dropped)
+
+    def mark_dead(self, shard: int) -> None:
+        """Operator/test override: force a shard dead now (the organic path
+        is `fail_threshold` consecutive placement failures)."""
+        with self._lock:
+            if shard in self._dead:
+                return
+            if len(self._dead) + 1 >= len(self.shards):
+                raise RuntimeError("refusing to kill the last alive shard")
+            self._mark_dead(shard, failures=self._fail_counts.get(shard, 0))
+
+    def _remap_locked(self) -> int:
+        """Move every resident entry to its current alive owner (caller
+        holds self._lock) — the same walk `rebalance` does, over the live
+        shard list instead of a rebuilt one. Returns the move count."""
+        moved = 0
+        for idx, shard in enumerate(self.shards):
+            for image_id in shard.keys():  # LRU order: recency survives
+                new_idx = self._alive_owner(image_id)
+                if new_idx != idx:
+                    entry = shard.pop(image_id)
+                    self.shards[new_idx].adopt(image_id, entry)
+                    moved += 1
+        return moved
+
+    def mark_alive(self, shard: int) -> int:
+        """Re-adopt a recovered shard: clear its dead mark, then remap —
+        entries its range parked on fallback shards move back to it.
+        Returns the move count (0 if the shard wasn't dead)."""
+        with self._lock:
+            if shard not in self._dead:
+                return 0
+            self._dead.discard(shard)
+            self._fail_counts.pop(shard, None)
+            moved = self._remap_locked()
+            shards = len(self.shards)
+            dead_now = len(self._dead)
+        telemetry.counter(_METRIC_PREFIX + ".rebalance").inc(moved)
+        telemetry.gauge(_METRIC_PREFIX + ".dead").set(dead_now)
+        telemetry.emit("serve.shard_revive", shard=shard, shards=shards,
+                       moved=moved)
+        return moved
 
     def rebalance(self, num_shards: int) -> int:
         """Repartition to `num_shards` key ranges, moving every resident
         entry whose owner changed; returns the move count. The per-shard
-        budget is re-derived from the fleet-level `capacity_bytes`."""
+        budget is re-derived from the fleet-level `capacity_bytes`. A
+        rebalance REBUILDS every shard, so dead marks and failure tallies
+        reset — the new topology starts clean."""
         if num_shards < 1:
             raise ValueError(f"num_shards must be >= 1, got {num_shards}")
-        old = self.shards
-        per = self.capacity_bytes // num_shards if self.capacity_bytes else 0
-        self.shards = [MPICache(capacity_bytes=per, quant=self.quant)
-                       for _ in range(num_shards)]
-        moved = 0
-        for old_idx, shard in enumerate(old):
-            for image_id in shard.keys():  # LRU order: recency survives
-                entry = shard._entries[image_id]
-                new_idx = self.owner(image_id)
-                self.shards[new_idx].adopt(image_id, entry)
-                moved += int(new_idx != old_idx)
-        self.rebalances += 1
+        with self._lock:
+            old = self.shards
+            per = (self.capacity_bytes // num_shards
+                   if self.capacity_bytes else 0)
+            self.shards = [MPICache(capacity_bytes=per, quant=self.quant)
+                           for _ in range(num_shards)]
+            self._dead.clear()
+            self._fail_counts.clear()
+            moved = 0
+            for old_idx, shard in enumerate(old):
+                for image_id in shard.keys():  # LRU order: recency survives
+                    entry = shard._entries[image_id]
+                    new_idx = self.owner(image_id)
+                    self.shards[new_idx].adopt(image_id, entry)
+                    moved += int(new_idx != old_idx)
+            self.rebalances += 1
+            entries = sum(len(s) for s in self.shards)
+        telemetry.gauge(_METRIC_PREFIX + ".dead").set(0)
         telemetry.counter(_METRIC_PREFIX + ".rebalance").inc(moved)
         telemetry.emit("serve.shard.rebalance", from_shards=len(old),
                        to_shards=num_shards, moved=moved,
-                       entries=len(self))
+                       entries=entries)
         return moved
 
     def stats(self) -> dict:
-        agg = {"entries": len(self), "nbytes": self.nbytes,
-               "shards": self.num_shards, "quant": self.quant,
+        with self._lock:
+            per_shard = [{"entries": len(s), "nbytes": s.nbytes,
+                          "dead": i in self._dead}
+                         for i, s in enumerate(self.shards)]
+            dead = sorted(self._dead)
+            shard_stats = [s.stats() for s in self.shards]
+        agg = {"entries": sum(p["entries"] for p in per_shard),
+               "nbytes": sum(p["nbytes"] for p in per_shard),
+               "shards": len(per_shard), "quant": self.quant,
                "owner_hits": self.owner_hits,
                "remote_routes": self.remote_routes,
                "owner_encodes": self.owner_encodes,
-               "rebalances": self.rebalances}
+               "rebalances": self.rebalances,
+               "failovers": self.failovers,
+               "dead_shards": dead}
         for k in ("hits", "misses", "evictions"):
-            agg[k] = sum(s.stats()[k] for s in self.shards)
-        agg["per_shard"] = [
-            {"entries": len(s), "nbytes": s.nbytes} for s in self.shards]
+            agg[k] = sum(s[k] for s in shard_stats)
+        agg["per_shard"] = per_shard
         return agg
 
 
@@ -187,6 +334,13 @@ class ServeFleet:
     key routed to its owner, render coalesced by the scheduler); `render` /
     `render_many` pass through to the engine for trajectory-style callers
     (serve_cli's video path).
+
+    Self-protection (PR 11, all default-off): an `AdmissionController`
+    sheds/degrades low tiers under pressure (serve/admission.py), requests
+    carry priority tiers and deadlines into the batcher, the engine retries
+    transient encode failures with jittered backoff, and the sharded cache
+    fails over dead shards. `/healthz` on the ops endpoint reports
+    `degraded` when the error budget is burning > 1x or a shard is dead.
     """
 
     def __init__(self, *,
@@ -207,13 +361,26 @@ class ServeFleet:
                  slo_target: float = 0.99,
                  slo_window_s: float = 60.0,
                  ops_port: Optional[int] = None,
+                 default_tier: int = 1,
+                 request_deadline_ms: float = 0.0,
+                 encode_retries: int = 0,
+                 encode_backoff_ms: float = 10.0,
+                 shard_fail_threshold: int = 3,
+                 admission_enabled: bool = False,
+                 admission_burn_max: float = 1.0,
+                 admission_queue_high: int = 64,
+                 admission_inflight_high: int = 256,
+                 admission_shed_factor: float = 2.0,
+                 admission_hysteresis: float = 0.7,
                  **engine_kw):
         self.cache = ShardedPlaneCache(
             num_shards=cache_shards, capacity_bytes=cache_bytes,
-            quant=cache_quant)
+            quant=cache_quant, fail_threshold=shard_fail_threshold)
         self.engine = MeshRenderEngine(
             mesh_batch=mesh_batch, mesh_model=mesh_model, devices=devices,
             max_bucket=max_bucket, cache=self.cache, encode_fn=encode_fn,
+            encode_retries=encode_retries,
+            encode_backoff_ms=encode_backoff_ms,
             **engine_kw)
         if scheduler not in ("continuous", "micro"):
             raise ValueError(
@@ -225,16 +392,31 @@ class ServeFleet:
         # is for traces) — the batcher's flush path feeds it
         self.slo = SLOTracker(objective_ms=slo_objective_ms,
                               target=slo_target, window_s=slo_window_s)
+        # the admission controller's burn signal is the SLO tracker's
+        # cached ratio (lock-free read — slo.burn)
+        self.admission: Optional[AdmissionController] = None
+        if admission_enabled:
+            self.admission = AdmissionController(
+                enabled=True, burn_max=admission_burn_max,
+                queue_high=admission_queue_high,
+                inflight_high=admission_inflight_high,
+                shed_factor=admission_shed_factor,
+                hysteresis=admission_hysteresis,
+                burn_fn=lambda: self.slo.burn)
         batcher_cls = ContinuousBatcher if scheduler == "continuous" \
             else MicroBatcher
         self.batcher = batcher_cls(self.engine, max_requests=max_requests,
                                    max_wait_ms=max_wait_ms, start=start,
-                                   slo=self.slo, auto_trace=False)
+                                   slo=self.slo, auto_trace=False,
+                                   admission=self.admission,
+                                   default_tier=default_tier,
+                                   request_deadline_ms=request_deadline_ms)
         self._front = itertools.count()
         # opt-in live ops plane; port 0 binds ephemeral (tests), None = off
         self.ops: Optional[OpsServer] = None
         if ops_port is not None:
-            self.ops = OpsServer(port=ops_port, slo=self.slo).start()
+            self.ops = OpsServer(port=ops_port, slo=self.slo,
+                                 health=self.health).start()
 
     @classmethod
     def from_config(cls, serve_cfg, encode_fn=None, start: bool = True,
@@ -256,16 +438,34 @@ class ServeFleet:
                    slo_window_s=serve_cfg.slo_window_s,
                    ops_port=serve_cfg.ops_port if serve_cfg.ops_port > 0
                    else None,
+                   default_tier=serve_cfg.default_tier,
+                   request_deadline_ms=serve_cfg.request_deadline_ms,
+                   encode_retries=serve_cfg.encode_retries,
+                   encode_backoff_ms=serve_cfg.encode_backoff_ms,
+                   shard_fail_threshold=serve_cfg.shard_fail_threshold,
+                   admission_enabled=serve_cfg.admission_enabled,
+                   admission_burn_max=serve_cfg.admission_burn_max,
+                   admission_queue_high=serve_cfg.admission_queue_high,
+                   admission_inflight_high=serve_cfg.admission_inflight_high,
+                   admission_shed_factor=serve_cfg.admission_shed_factor,
+                   admission_hysteresis=serve_cfg.admission_hysteresis,
                    encode_fn=encode_fn, start=start, devices=devices,
                    **engine_kw)
 
     def num_devices(self) -> int:
         return self.engine.num_devices()
 
-    def submit(self, image_id: str, pose_44):
+    def submit(self, image_id: str, pose_44, tier: Optional[int] = None,
+               deadline_ms: Optional[float] = None, image=None):
         """One view request through the fleet: round-robin front-end shard,
         owner routing (telemetry), scheduler coalescing. Resolves to
         (rgb [3,H,W], depth [1,H,W]) f32 numpy.
+
+        `tier` is the request's priority class (serve/admission.py tier
+        constants; None = the fleet's default_tier), `deadline_ms` its
+        end-to-end budget (None = the fleet default; expired requests are
+        purged un-rendered), `image` the pixels for a sync-encode on miss.
+        A shed request's future resolves to `RequestShed`.
 
         A sampled request's trace is born HERE — the route decision is its
         first child span (front shard, owner shard, remote hop or not) and
@@ -279,7 +479,9 @@ class ServeFleet:
             trace.add_span("route", (time.perf_counter() - t0) * 1e3, t0=t0,
                            front_shard=caller, owner_shard=owner,
                            remote=caller != owner)
-        return self.batcher.submit(image_id, pose_44, trace=trace)
+        return self.batcher.submit(image_id, pose_44, trace=trace,
+                                   tier=tier, deadline_ms=deadline_ms,
+                                   image=image)
 
     def render(self, image_id: str, poses_P44, **kw):
         return self.engine.render(image_id, poses_P44, **kw)
@@ -293,12 +495,28 @@ class ServeFleet:
     def warmup(self, image_id: str, **kw) -> None:
         self.engine.warmup(image_id, **kw)
 
+    def health(self) -> dict:
+        """Liveness with a degraded flag (what /healthz serves): the fleet
+        is `degraded` — still up, still HTTP 200 — when the error budget is
+        burning faster than 1x or any cache shard is marked dead."""
+        dead = self.cache.dead_shards
+        burn = self.slo.burn
+        degraded = bool(dead) or burn > 1.0
+        return {"status": "degraded" if degraded else "ok",
+                "error_budget_burn": round(burn, 4),
+                "dead_shards": dead,
+                "admission": self.admission.state if self.admission
+                else "off"}
+
     def stats(self) -> dict:
         s = self.cache.stats()
         s.update(device_calls=self.engine.device_calls,
                  sync_encodes=self.engine.sync_encodes,
                  flushes=self.batcher.flushes,
                  slo_breaches=self.slo.breaches,
+                 expired=self.batcher.expired,
+                 shed=self.admission.shed if self.admission else 0,
+                 degraded=self.admission.degraded if self.admission else 0,
                  mesh=f"{self.engine.mesh_batch}x{self.engine.mesh_model}")
         return s
 

@@ -200,6 +200,9 @@ KIND_FIELDS: Dict[str, tuple] = {
     "serve.slo_breach": ("p99_ms", "objective_ms", "window_s"),
     "serve.shard.place": ("image_id", "shard", "shards"),
     "serve.shard.rebalance": ("from_shards", "to_shards", "moved"),
+    "serve.admission": ("state", "prev", "queue_depth", "inflight"),
+    "serve.shard_dead": ("shard", "shards", "failures", "dropped"),
+    "serve.shard_revive": ("shard", "shards", "moved"),
     "metrics.snapshot": ("scope", "metrics"),
     "profile.window": ("start_step", "stop_step", "trace_dir"),
 }

@@ -12,7 +12,13 @@ round-trip through (it validates the grammar we emit, not the full spec).
 (`ThreadingHTTPServer` on a daemon thread, loopback by default):
 
     /metrics        Prometheus text from the registry
-    /healthz        200 "ok" (liveness)
+    /healthz        200 JSON liveness: {"status": "ok"} or, with a `health`
+                    callable wired (the fleet's — serve/fleet.py), that
+                    callable's dict — `status` flips to "degraded" (STILL
+                    HTTP 200: the process is up and serving; "degraded" is
+                    a body-level signal for dashboards, not a probe
+                    failure) when the error budget burns > 1x or a cache
+                    shard is marked dead
     /slo            rolling-window SLO snapshot (telemetry/slo.py), JSON
     /traces/recent  last completed traces (telemetry/tracing.py), JSON
 
@@ -120,7 +126,7 @@ class OpsServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[_registry.MetricsRegistry] = None,
-                 slo=None, traces_limit: int = 32):
+                 slo=None, traces_limit: int = 32, health=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         ops = self
@@ -128,6 +134,9 @@ class OpsServer:
             else _registry.REGISTRY
         self.slo = slo
         self.traces_limit = int(traces_limit)
+        # optional () -> dict with at least a "status" key; None = bare
+        # liveness (the process answering IS the health signal)
+        self.health = health
 
         class _Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes,
@@ -142,7 +151,9 @@ class OpsServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/healthz":
-                        self._send(200, b"ok\n", "text/plain")
+                        body = ops.health() if ops.health is not None \
+                            else {"status": "ok"}
+                        self._send(200, (json.dumps(body) + "\n").encode())
                     elif path == "/metrics":
                         body = render_prometheus(ops.registry)
                         self._send(200, body.encode(), CONTENT_TYPE)

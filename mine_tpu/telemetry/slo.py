@@ -15,7 +15,10 @@ rolling p99 against a configurable objective:
     triggered: one event per excursion, not one per request while bad);
   * `snapshot()` returns the JSON the ops endpoint's `/slo` route serves,
     including per-bucket percentiles (bucket = the dispatch batch's pow2
-    size, so tail latency reads per compiled shape).
+    size, so tail latency reads per compiled shape) and per-tier
+    percentiles (tier = the request's admission priority class, so the
+    queue-flood tests can prove high-tier latency held while low tiers
+    shed — serve/admission.py).
 
 Error-budget burn is the standard SRE ratio: with target 0.99, the budget
 is 1% of requests over objective; burn = (observed bad fraction) /
@@ -75,13 +78,17 @@ class SLOTracker:
         self.max_samples = int(max_samples)
         self.metric_prefix = metric_prefix
         self._lock = ordered_lock("telemetry.slo")
-        # (t_monotonic, latency_ms, bucket) — bounded twice: by age
+        # (t_monotonic, latency_ms, bucket, tier) — bounded twice: by age
         # (window_s, pruned on every record/snapshot) and by count
         # (max_samples, the deque's maxlen)
         self._samples: deque = deque(maxlen=self.max_samples)
         self._breaching = False
         self.breaches = 0
         self.recorded = 0
+        # cached burn from the last record()/snapshot(): read LOCK-FREE by
+        # the admission controller's pressure score (serve/admission.py) —
+        # a shed decision must never contend with the window's lock
+        self._last_burn = 0.0
 
     # ---------------- internals (callers hold self._lock) ----------------
 
@@ -104,16 +111,19 @@ class SLOTracker:
     # ---------------- recording ----------------
 
     def record(self, latency_ms: float, bucket: Optional[int] = None,
-               now: Optional[float] = None) -> None:
+               now: Optional[float] = None,
+               tier: Optional[int] = None) -> None:
         """Record one request's end-to-end latency. `bucket` tags the
-        dispatch batch's pow2 size (per-shape tail in snapshot())."""
+        dispatch batch's pow2 size, `tier` the request's priority class
+        (per-shape and per-tier tails in snapshot())."""
         if now is None:
             now = time.monotonic()
         with self._lock:
-            self._samples.append((now, float(latency_ms), bucket))
+            self._samples.append((now, float(latency_ms), bucket, tier))
             self.recorded += 1
             self._prune(now)
             st = self._window_stats()
+            self._last_burn = st["burn"]
             breach_edge = False
             if (self.objective_ms and st["n"] >= MIN_BREACH_SAMPLES
                     and st["p99_ms"] > self.objective_ms):
@@ -142,6 +152,13 @@ class SLOTracker:
         with self._lock:
             return self._breaching
 
+    @property
+    def burn(self) -> float:
+        """Error-budget burn as of the last record()/snapshot() — a plain
+        cached float, read WITHOUT the lock (atomic in CPython) so the
+        admission controller's per-request pressure score costs nothing."""
+        return self._last_burn
+
     # ---------------- reporting ----------------
 
     def snapshot(self, now: Optional[float] = None) -> Dict:
@@ -152,17 +169,24 @@ class SLOTracker:
         with self._lock:
             self._prune(now)
             st = self._window_stats()
+            self._last_burn = st["burn"]
             per_bucket: Dict = {}
-            for _, ms, bucket in self._samples:
+            per_tier: Dict = {}
+            for _, ms, bucket, tier in self._samples:
                 per_bucket.setdefault(bucket, []).append(ms)
-            buckets = {}
-            for bucket in sorted(per_bucket,
-                                 key=lambda b: (b is None, b)):
-                vals = sorted(per_bucket[bucket])
-                buckets[str(bucket)] = {
-                    "n": len(vals),
-                    "p50_ms": round(_pct(vals, 0.50), 3),
-                    "p99_ms": round(_pct(vals, 0.99), 3)}
+                if tier is not None:
+                    per_tier.setdefault(tier, []).append(ms)
+            def _pct_table(groups):
+                table = {}
+                for key in sorted(groups, key=lambda k: (k is None, k)):
+                    vals = sorted(groups[key])
+                    table[str(key)] = {
+                        "n": len(vals),
+                        "p50_ms": round(_pct(vals, 0.50), 3),
+                        "p99_ms": round(_pct(vals, 0.99), 3)}
+                return table
+            buckets = _pct_table(per_bucket)
+            tiers = _pct_table(per_tier)
             breaching = self._breaching
             breaches = self.breaches
             recorded = self.recorded
@@ -171,7 +195,7 @@ class SLOTracker:
                "recorded": recorded, "breaching": breaching,
                "breaches": breaches,
                "error_budget_burn": round(st["burn"], 4),
-               "buckets": buckets}
+               "buckets": buckets, "tiers": tiers}
         for k in ("p50_ms", "p99_ms"):
             v = st[k]
             out[k] = round(v, 3) if v == v else None  # NaN -> null (JSON)

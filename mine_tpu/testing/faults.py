@@ -22,6 +22,22 @@ tools/chaos_soak.py) can drive the recovery paths end-to-end:
     the per-item retry/quarantine machinery and kills the assembler
     thread outright (the worker-respawn path).
 
+Serve-side seams (tests/test_serve_resilience.py, tools/serve_chaos_soak.py
+drive the PR-11 self-protecting-serving layer through them):
+
+  * ``encode_raise_times`` — the first k synchronous encodes raise
+    InjectedEncodeError (transient: exercises the engine's bounded
+    retry-with-backoff path; a large k exhausts the retries).
+  * ``shard_kill`` / ``shard_kill_heal_after`` — placements on that cache
+    shard raise InjectedShardError until ``heal_after`` failures have been
+    injected (-1 = never heals): the shard-failover path — consecutive
+    failures mark the shard dead, its key range re-routes, and
+    ``ShardedPlaneCache.mark_alive`` re-adopts it after the heal.
+  * ``slow_render_ms`` — host-side sleep before every render dispatch
+    (builds queue depth for the admission/deadline paths).
+  * ``queue_flood`` — a burst size the soak/test harness reads via
+    ``queue_flood_n`` and submits as one instantaneous tier-0 flood.
+
 The plan comes from ``set_plan`` (tests), the MINE_TPU_FAULTS env var
 (subprocess legs of the chaos soak), or a config's ``testing.fault_plan``
 JSON (train_cli). With no plan active every hook is a cheap no-op, so the
@@ -35,6 +51,7 @@ import json
 import os
 import signal
 import threading
+import time
 from typing import Dict, Optional
 
 ENV_VAR = "MINE_TPU_FAULTS"
@@ -50,6 +67,14 @@ class InjectedItemError(ValueError):
     """The injected per-item load failure (transient or persistent)."""
 
 
+class InjectedEncodeError(RuntimeError):
+    """The injected synchronous-encode failure (the engine retry path)."""
+
+
+class InjectedShardError(RuntimeError):
+    """The injected cache-shard placement failure (the failover path)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """-1 disables a fault everywhere below."""
@@ -59,6 +84,11 @@ class FaultPlan:
     item_raise_index: int = -1     # dataset index whose load raises
     item_raise_times: int = -1     # -1: always; k>0: first k loads only
     kill_worker_at_call: int = -1  # nth item load (1-based) dies WorkerKill
+    encode_raise_times: int = -1   # first k sync encodes raise (transient)
+    shard_kill: int = -1           # cache shard whose placements fail
+    shard_kill_heal_after: int = -1  # injected failures before it heals
+    slow_render_ms: int = -1       # host sleep before each render dispatch
+    queue_flood: int = -1          # burst size the soak reads (queue_flood_n)
 
     @property
     def active(self) -> bool:
@@ -160,6 +190,61 @@ def maybe_sigterm(gstep: int):
         else:
             return
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+def on_encode(image_id: str = ""):
+    """Called by the engine at the top of every synchronous-encode attempt
+    (serve/engine.py _entry). The first `encode_raise_times` attempts raise
+    — PROCESS-wide, not per-image, so a retry loop sees consecutive
+    transient failures exactly like a flaky encoder would produce."""
+    plan = _plan
+    if plan is None or plan.encode_raise_times < 0:
+        return
+    with _lock:
+        seen = _counts.get("encode_fails", 0)
+        if seen >= plan.encode_raise_times:
+            return
+        _counts["encode_fails"] = seen + 1
+    raise InjectedEncodeError(
+        f"injected sync-encode failure #{seen + 1} "
+        f"(image {str(image_id)[:12]})")
+
+
+def on_shard_put(shard: int):
+    """Called by ShardedPlaneCache.put with the target shard before the
+    placement lands. Placements on `shard_kill` fail until
+    `shard_kill_heal_after` failures have been injected (-1: never heals) —
+    the consecutive-failure signal that marks a shard dead."""
+    plan = _plan
+    if plan is None or plan.shard_kill < 0 or shard != plan.shard_kill:
+        return
+    with _lock:
+        n = _counts.get("shard_put_fails", 0)
+        if 0 <= plan.shard_kill_heal_after <= n:
+            return  # healed: further placements succeed
+        _counts["shard_put_fails"] = n + 1
+    raise InjectedShardError(
+        f"injected placement failure on shard {shard} (#{n + 1})")
+
+
+def on_render():
+    """Called by the engine before each render dispatch; sleeps
+    `slow_render_ms` to simulate a slow device call (queue pressure for
+    the admission / deadline paths)."""
+    plan = _plan
+    if plan is None or plan.slow_render_ms < 0:
+        return
+    time.sleep(plan.slow_render_ms / 1e3)
+
+
+def queue_flood_n() -> int:
+    """Burst size for the soak/test harness's instantaneous tier-0 flood
+    (the harness submits; this just carries the number through the same
+    plan plumbing as every other fault)."""
+    plan = _plan
+    if plan is None or plan.queue_flood < 0:
+        return 0
+    return plan.queue_flood
 
 
 # ---------------- checkpoint corruption (test/soak helper) ----------------

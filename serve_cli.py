@@ -135,11 +135,21 @@ def main():
         logger.info("serving fleet: mesh=%dx%d cache_shards=%d scheduler=%s",
                     serve_cfg.mesh_batch, serve_cfg.mesh_model,
                     serve_cfg.cache_shards, serve_cfg.scheduler)
+        if fleet.admission is not None:
+            logger.info("admission control: burn_max=%.2f queue_high=%d "
+                        "inflight_high=%d shed_factor=%.2f hysteresis=%.2f",
+                        serve_cfg.admission_burn_max,
+                        serve_cfg.admission_queue_high,
+                        serve_cfg.admission_inflight_high,
+                        serve_cfg.admission_shed_factor,
+                        serve_cfg.admission_hysteresis)
     else:
         engine = RenderEngine(
             max_bucket=serve_cfg.max_bucket,
             cache=MPICache(capacity_bytes=serve_cfg.cache_bytes,
                            quant=serve_cfg.cache_quant),
+            encode_retries=serve_cfg.encode_retries,
+            encode_backoff_ms=serve_cfg.encode_backoff_ms,
             **engine_kw)
         slo = telemetry.SLOTracker(objective_ms=serve_cfg.slo_objective_ms,
                                    target=serve_cfg.slo_target,
@@ -201,8 +211,11 @@ def main():
                 stats.get("owner_encodes", 0), stats.get("rebalances", 0))
     if fleet is not None:
         fs = fleet.stats()
-        logger.info("fleet stats: mesh=%s shards=%d slo_breaches=%d",
-                    fs["mesh"], fs["shards"], fs["slo_breaches"])
+        logger.info("fleet stats: mesh=%s shards=%d slo_breaches=%d "
+                    "shed=%d degraded=%d expired=%d dead_shards=%s",
+                    fs["mesh"], fs["shards"], fs["slo_breaches"],
+                    fs["shed"], fs["degraded"], fs["expired"],
+                    fs["dead_shards"])
         fleet.close()
     elif ops is not None:
         ops.close()

@@ -69,6 +69,7 @@ QUICK = {
     "test_sampling.py::test_stratified_linspace_bins",
     "test_serve.py::test_lru_eviction_order_under_byte_budget",
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
+    "test_serve_resilience.py::test_admission_tier_policy_matrix",
     "test_train.py::test_multistep_lr_schedule",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
@@ -111,6 +112,10 @@ MEDIUM_FILES = {
     # the fleet layer on top of it (mesh render bitwise parity, key-range
     # cache sharding, continuous batching): ~20 s, same reviewer concern
     "test_serve_fleet.py",
+    # the self-protection layer over both (admission, degradation ladder,
+    # deadlines, shard failover — all chaos-driven) plus its default-off
+    # bitwise parity bar: same reviewer concern as the two above
+    "test_serve_resilience.py",
     # the telemetry layer's contracts (histogram math, event schema, the
     # frozen st1 step line, bitwise-unchanged instrumented paths): cheap
     # (~25 s) and every other subsystem now routes through it

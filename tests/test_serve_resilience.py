@@ -1,0 +1,606 @@
+"""Self-protecting serving (PR 11): admission control, the graceful
+degradation ladder, deadlines, encode retry, and shard failover — every
+behavior driven through the chaos seams in mine_tpu/testing/faults.py.
+
+The load-bearing contracts, each asserted here:
+  * the AdmissionController's level machine escalates immediately,
+    de-escalates hysteretically, and emits ONE serve.admission event per
+    transition (edge-triggered, like SLO breaches);
+  * under a queue flood, tier-0 requests shed with `RequestShed` while
+    tier-2 requests ALL complete, dispatched highest-tier-first;
+  * the degradation ladder steps a degraded miss's encode down one cache
+    quant, caps an all-degraded batch at half the pose bucket, and a
+    mixed-dtype batch still renders correctly;
+  * the deadline sweep purges already-expired requests at dispatch time —
+    they resolve `DeadlineExceeded` and are NEVER rendered (fake clock);
+  * transient sync-encode failures heal inside the bounded jittered-backoff
+    retry, count exactly, and do NOT consume the one-time slow-path
+    warning (the warning fires only on a clean first-attempt miss);
+  * consecutive placement failures mark a shard dead (serve.shard_dead),
+    its key range re-routes ring-wise, and mark_alive re-adopts it
+    (serve.shard_revive) — with zero failed requests end to end;
+  * rebalance() racing concurrent submit()s never corrupts results;
+  * /healthz reports `degraded` (still HTTP 200) on budget burn or a dead
+    shard;
+  * with every feature at its default-off setting the serve path is
+    bitwise-identical to the plain engine (the PR-10 parity bar).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from mine_tpu import telemetry
+from mine_tpu.serve import (MPICache, RenderEngine, ServeFleet,
+                            ShardedPlaneCache)
+from mine_tpu.serve.admission import (TIER_BEST_EFFORT, TIER_CRITICAL,
+                                      AdmissionController, DeadlineExceeded,
+                                      RequestShed)
+from mine_tpu.serve.batcher import MicroBatcher
+from mine_tpu.telemetry import events as tevents
+from mine_tpu.telemetry.slo import SLOTracker
+from mine_tpu.testing import faults
+from mine_tpu.testing.faults import FaultPlan, InjectedEncodeError
+
+S = 4
+HW = 8
+POSE = np.eye(4, dtype=np.float32)
+IMG = np.zeros((HW, HW, 3), np.float32)
+
+
+def _mpi_parts(seed=0):
+    rng = np.random.RandomState(seed)
+    p = rng.uniform(-1, 1, (S, 4, HW, HW)).astype(np.float32)
+    return (p[:, 0:3], p[:, 3:4],
+            np.linspace(1.0, 0.2, S, dtype=np.float32),
+            np.eye(3, dtype=np.float32))
+
+
+def _encode_fn(img_hwc):
+    """Deterministic synchronous encode stand-in (image -> fixed MPI)."""
+    return _mpi_parts(seed=0)
+
+
+def _engine(quant="bf16", **kw):
+    return RenderEngine(cache=MPICache(quant=quant), max_bucket=8,
+                        encode_fn=_encode_fn, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.set_plan(None)
+
+
+@pytest.fixture
+def event_stream(tmp_path, monkeypatch):
+    """Route the event sink to a temp file; yields its path. Reset closes
+    the sink so every line is on disk before validation."""
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    yield path
+    tevents.reset()
+
+
+# ---------------- admission controller unit ----------------
+
+def test_admission_disabled_is_constant_admit():
+    ctl = AdmissionController(enabled=False, queue_high=1)
+    for depth in (0, 10, 10_000):
+        assert ctl.decide(TIER_BEST_EFFORT, depth, depth) == "admit"
+    assert ctl.state == "ok" and ctl.transitions == 0
+    assert ctl.shed == 0 and ctl.degraded == 0
+
+
+def test_admission_score_is_max_over_configured_signals():
+    burn = [0.0]
+    ctl = AdmissionController(enabled=True, burn_max=2.0, queue_high=10,
+                              inflight_high=100, burn_fn=lambda: burn[0])
+    assert ctl.score(5, 50) == 0.5          # max(0, 0.5, 0.5)
+    burn[0] = 3.0
+    assert ctl.score(0, 0) == 1.5           # burn dominates
+    # threshold <= 0 disables that signal entirely
+    off = AdmissionController(enabled=True, burn_max=0.0, queue_high=0,
+                              inflight_high=100, burn_fn=lambda: 99.0)
+    assert off.score(10_000, 50) == 0.5
+
+
+def test_admission_tier_policy_matrix():
+    ctl = AdmissionController(enabled=True, burn_max=0.0, queue_high=10,
+                              inflight_high=0, shed_factor=2.0)
+    # level ok: everything admits
+    assert ctl.decide(TIER_BEST_EFFORT, 0, 0) == "admit"
+    # level degrade (1.0 <= score < 2.0): tier 0 degrades, tier 1+ admits
+    assert ctl.decide(TIER_BEST_EFFORT, 10, 0) == "degrade"
+    assert ctl.decide(1, 15, 0) == "admit"
+    # level shed (score >= 2.0): tier 0 sheds, tier 1 degrades, 2+ admits
+    assert ctl.decide(TIER_BEST_EFFORT, 20, 0) == "shed"
+    assert ctl.decide(1, 20, 0) == "degrade"
+    assert ctl.decide(TIER_CRITICAL, 20, 0) == "admit"
+    assert ctl.shed == 1 and ctl.degraded == 2
+
+
+def test_admission_hysteresis_and_edge_triggered_events(event_stream):
+    ctl = AdmissionController(enabled=True, burn_max=0.0, queue_high=10,
+                              inflight_high=0, shed_factor=2.0,
+                              hysteresis=0.7)
+    # escalation is immediate (ok -> shed in one decide)
+    ctl.decide(1, 25, 0)
+    assert ctl.state == "shed" and ctl.transitions == 1
+    # score back under the shed line but above hysteresis: state HOLDS
+    ctl.decide(1, 15, 0)  # score 1.5 >= 2.0 * 0.7
+    assert ctl.state == "shed" and ctl.transitions == 1
+    # below 2.0*0.7: one step down per decide, never straight to ok
+    ctl.decide(1, 13, 0)  # score 1.3 < 1.4
+    assert ctl.state == "degrade" and ctl.transitions == 2
+    ctl.decide(1, 13, 0)  # 1.3 >= 1.0: degrade holds
+    assert ctl.state == "degrade"
+    ctl.decide(1, 6, 0)   # 0.6 < 1.0 * 0.7
+    assert ctl.state == "ok" and ctl.transitions == 3
+    tevents.reset()
+    events = [e for e in tevents.read_events(event_stream)
+              if e["kind"] == "serve.admission"]
+    assert [e["state"] for e in events] == ["shed", "degrade", "ok"]
+    assert [e["prev"] for e in events] == ["ok", "shed", "degrade"]
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+
+
+def test_admission_validates_parameters():
+    with pytest.raises(ValueError, match="shed_factor"):
+        AdmissionController(shed_factor=1.0)
+    with pytest.raises(ValueError, match="hysteresis"):
+        AdmissionController(hysteresis=0.0)
+
+
+# ---------------- queue flood: shed low tiers, serve high ----------------
+
+def test_queue_flood_sheds_tier0_serves_tier2(event_stream):
+    """The headline chaos scenario: an instantaneous tier-0 flood (sized by
+    the fault plan's queue_flood seam) against a tight admission config.
+    Every tier-2 request completes; tier-0 sheds once the queue crosses the
+    shed line; dispatch is highest-tier-first."""
+    faults.set_plan(FaultPlan(queue_flood=24))
+    flood_n = faults.queue_flood_n()
+    assert flood_n == 24
+    eng = _engine()
+    eng.put("img", *_mpi_parts())
+    admission = AdmissionController(enabled=True, burn_max=0.0,
+                                    queue_high=4, inflight_high=0,
+                                    shed_factor=2.0)
+    b = MicroBatcher(eng, max_requests=4, start=False, admission=admission)
+    flood = [b.submit("img", POSE, tier=TIER_BEST_EFFORT)
+             for _ in range(flood_n)]
+    crit = [b.submit("img", POSE, tier=TIER_CRITICAL) for _ in range(3)]
+    # the flood crossed queue_high*shed_factor: controller is shedding,
+    # and the shed futures resolved immediately (fast failure)
+    assert admission.state == "shed"
+    assert admission.shed > 0
+    shed = [f for f in flood if f.done()]
+    assert len(shed) == admission.shed
+    for f in shed:
+        with pytest.raises(RequestShed):
+            f.result()
+    # first dispatch is priority-ordered: every critical request rides it
+    assert b.flush() == 4
+    assert all(f.done() for f in crit)
+    for f in crit:
+        rgb, depth = f.result()
+        assert rgb.shape == (3, HW, HW) and depth.shape == (1, HW, HW)
+    while b.flush():
+        pass
+    for f in flood:  # everything admitted eventually rendered
+        if f not in shed:
+            f.result()
+    tevents.reset()
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    kinds = [e["kind"] for e in tevents.read_events(event_stream)]
+    assert "serve.admission" in kinds
+
+
+# ---------------- degradation ladder ----------------
+
+def test_degraded_miss_encodes_at_stepped_down_quant():
+    eng = _engine(quant="bf16")
+    eng.render_many([("deg", POSE)], images=[IMG], degraded=[True])
+    import jax.numpy as jnp
+    assert eng.cache._entries["deg"].planes.dtype == jnp.int8
+    # a full-fidelity co-rider keeps the shared entry at the cache default
+    eng2 = _engine(quant="bf16")
+    eng2.render_many([("x", POSE), ("x", POSE)], images=[IMG, IMG],
+                     degraded=[True, False])
+    assert eng2.cache._entries["x"].planes.dtype == jnp.bfloat16
+    # float32 default steps to bf16; int8 is already the floor
+    eng3 = _engine(quant="float32")
+    eng3.render_many([("y", POSE)], images=[IMG], degraded=[True])
+    assert eng3.cache._entries["y"].planes.dtype == jnp.bfloat16
+    eng4 = _engine(quant="int8")
+    eng4.render_many([("z", POSE)], images=[IMG], degraded=[True])
+    assert eng4.cache._entries["z"].planes.dtype == jnp.int8
+
+
+def test_all_degraded_batch_caps_at_half_bucket():
+    eng = _engine()
+    eng.put("img", *_mpi_parts())
+    admission = AdmissionController(enabled=True, burn_max=0.0,
+                                    queue_high=1, inflight_high=0,
+                                    shed_factor=100.0)  # degrade, never shed
+    b = MicroBatcher(eng, max_requests=4, start=False, admission=admission)
+    b.submit("img", POSE, tier=TIER_CRITICAL)  # not degraded (critical)
+    futs = [b.submit("img", POSE, tier=TIER_BEST_EFFORT) for _ in range(7)]
+    assert admission.degraded == 7
+    # first batch mixes the critical rider in: full bucket, no cap
+    assert b.flush() == 4
+    # the remaining queue is ALL degraded: capped at max(1, 4//2) = 2
+    assert b.flush() == 2
+    assert b.flush() == 2
+    assert b.flush() == 0
+    for f in futs:
+        f.result()
+
+
+def test_mixed_dtype_batch_renders_via_host_dequant():
+    """A degraded int8 placement coalescing with bf16 entries must render,
+    and each row must match the same entry rendered alone."""
+    eng = _engine(quant="bf16")
+    eng.put("a", *_mpi_parts(seed=1))
+    eng.render_many([("b", POSE)], images=[IMG], degraded=[True])  # int8
+    import jax.numpy as jnp
+    dtypes = {str(eng.cache._entries[k].planes.dtype) for k in ("a", "b")}
+    assert dtypes == {"bfloat16", "int8"}
+    mixed = eng.render_many([("a", POSE), ("b", POSE)])
+    solo_a = eng.render_many([("a", POSE)])[0]
+    solo_b = eng.render_many([("b", POSE)])[0]
+    np.testing.assert_allclose(mixed[0][0], solo_a[0], atol=1e-6)
+    np.testing.assert_allclose(mixed[1][0], solo_b[0], atol=1e-6)
+
+
+# ---------------- deadline sweep ----------------
+
+def test_deadline_sweep_purges_expired_before_dispatch():
+    """Regression (fake clock): a request whose deadline passed while
+    queued resolves DeadlineExceeded at dispatch time and is never
+    rendered — the live request still dispatches in the same flush."""
+    eng = _engine()
+    eng.put("img", *_mpi_parts())
+    b = MicroBatcher(eng, max_requests=4, start=False)
+    clock = [100.0]
+    b._now = lambda: clock[0]
+    expired = b.submit("img", POSE, deadline_ms=50.0)
+    alive = b.submit("img", POSE)           # no deadline
+    later = b.submit("img", POSE, deadline_ms=500.0)
+    before = eng.device_calls
+    clock[0] = 100.2                         # 200ms later: only #1 expired
+    n_exp = telemetry.counter("serve.batcher.expired").value
+    assert b.flush() == 2
+    with pytest.raises(DeadlineExceeded):
+        expired.result()
+    assert alive.result()[0].shape == (3, HW, HW)
+    assert later.result()[0].shape == (3, HW, HW)
+    assert b.expired == 1
+    assert telemetry.counter("serve.batcher.expired").value == n_exp + 1
+    # the expired request consumed NO device work beyond the live batch
+    assert eng.device_calls == before + 1
+    # an all-expired queue flushes to zero without any device call
+    f = b.submit("img", POSE, deadline_ms=1.0)
+    clock[0] = 101.0
+    assert b.flush() == 0
+    assert eng.device_calls == before + 1
+    with pytest.raises(DeadlineExceeded):
+        f.result()
+
+
+def test_default_request_deadline_applies_when_unset():
+    eng = _engine()
+    eng.put("img", *_mpi_parts())
+    b = MicroBatcher(eng, max_requests=4, start=False,
+                     request_deadline_ms=50.0)
+    clock = [0.0]
+    b._now = lambda: clock[0]
+    f_default = b.submit("img", POSE)                 # inherits 50ms
+    f_override = b.submit("img", POSE, deadline_ms=0)  # opts out
+    clock[0] = 1.0
+    assert b.flush() == 1
+    with pytest.raises(DeadlineExceeded):
+        f_default.result()
+    f_override.result()
+
+
+# ---------------- encode retry / backoff ----------------
+
+def test_transient_encode_failure_heals_inside_retry_budget():
+    from mine_tpu.serve import engine as engine_mod
+
+    faults.set_plan(FaultPlan(encode_raise_times=2))
+    eng = _engine(encode_retries=3, encode_backoff_ms=0.1)
+    engine_mod._warned_sync_encode.discard(id(eng))
+    retry0 = telemetry.counter("serve.encode_retry").value
+    rec0 = telemetry.counter("serve.encode_retry_recovered").value
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rgb, depth = eng.render("t", POSE[None], image=IMG)
+    # a recovered retry must NOT fire the one-time slow-path warning — the
+    # slot stays unconsumed for a genuine clean-miss slow path
+    assert not [w for w in rec if "SYNCHRONOUS" in str(w.message)]
+    assert rgb.shape == (1, 3, HW, HW)
+    assert eng.sync_encodes == 1  # one MISS, whatever the attempt count
+    assert telemetry.counter("serve.encode_retry").value == retry0 + 2
+    assert telemetry.counter(
+        "serve.encode_retry_recovered").value == rec0 + 1
+    assert "t" in eng.cache
+
+
+def test_clean_miss_still_warns_once():
+    from mine_tpu.serve import engine as engine_mod
+
+    eng = _engine(encode_retries=3)
+    engine_mod._warned_sync_encode.discard(id(eng))
+    with pytest.warns(UserWarning, match="SYNCHRONOUS encode"):
+        eng.render("w", POSE[None], image=IMG)
+
+
+def test_encode_retry_exhaustion_raises():
+    faults.set_plan(FaultPlan(encode_raise_times=5))
+    eng = _engine(encode_retries=1, encode_backoff_ms=0.1)
+    with pytest.raises(InjectedEncodeError):
+        eng.render("t", POSE[None], image=IMG)
+    assert eng.sync_encodes == 1
+    assert "t" not in eng.cache
+    # zero retries = the PR-10 behavior: first error propagates
+    faults.set_plan(FaultPlan(encode_raise_times=1))
+    eng0 = _engine(encode_retries=0)
+    with pytest.raises(InjectedEncodeError):
+        eng0.render("u", POSE[None], image=IMG)
+
+
+# ---------------- shard failover ----------------
+
+def test_shard_failover_reroutes_and_revives(event_stream):
+    """Placement failures on shard 1 cross the threshold -> shard marked
+    dead (serve.shard_dead), its key range re-routes ring-wise, and after
+    the injected fault heals mark_alive re-adopts it (serve.shard_revive)."""
+    faults.set_plan(FaultPlan(shard_kill=1, shard_kill_heal_after=2))
+    cache = ShardedPlaneCache(num_shards=2, fail_threshold=2)
+    iid = "c0000000aa"  # leading bits 0xc000... -> owner 1 at N=2
+    assert cache.owner(iid) == 1
+    for _ in range(2):
+        with pytest.raises(faults.InjectedShardError):
+            _ = cache.put(iid, *_mpi_parts())
+    assert cache.dead_shards == [1]
+    assert cache.failovers == 1
+    # the fault healed after 2 injections, but shard 1 is dead: the same
+    # key now routes to (and places on) the ring-next alive shard
+    assert cache.alive_owner(iid) == 0
+    cache.put(iid, *_mpi_parts())
+    assert iid in cache and len(cache.shards[0]) == 1
+    assert cache.get(iid) is not None
+    # recovery: mark_alive moves the parked entry back to its true owner
+    moved = cache.mark_alive(1)
+    assert moved == 1
+    assert cache.dead_shards == []
+    assert len(cache.shards[1]) == 1 and len(cache.shards[0]) == 0
+    assert cache.get(iid) is not None
+    assert cache.mark_alive(1) == 0  # idempotent
+    tevents.reset()
+    events = tevents.read_events(event_stream)
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    dead = [e for e in events if e["kind"] == "serve.shard_dead"]
+    revive = [e for e in events if e["kind"] == "serve.shard_revive"]
+    assert len(dead) == 1 and dead[0]["shard"] == 1
+    assert dead[0]["failures"] == 2
+    assert len(revive) == 1 and revive[0]["moved"] == 1
+
+
+def test_shard_failure_count_resets_on_success():
+    """The dead threshold is CONSECUTIVE failures: a success in between
+    resets the tally (one flaky placement never kills a shard)."""
+    faults.set_plan(FaultPlan(shard_kill=1, shard_kill_heal_after=1))
+    cache = ShardedPlaneCache(num_shards=2, fail_threshold=2)
+    iid = "c0000000aa"
+    with pytest.raises(faults.InjectedShardError):
+        cache.put(iid, *_mpi_parts())     # failure #1, then the fault heals
+    cache.put(iid, *_mpi_parts())         # success: tally resets
+    assert cache.dead_shards == []
+    assert cache._fail_counts == {}
+
+
+def test_never_kills_the_last_alive_shard():
+    faults.set_plan(FaultPlan(shard_kill=0, shard_kill_heal_after=-1))
+    cache = ShardedPlaneCache(num_shards=1, fail_threshold=1)
+    with pytest.raises(faults.InjectedShardError):
+        cache.put("00aa", *_mpi_parts())
+    assert cache.dead_shards == []  # a 1-shard cache can't fail over
+    two = ShardedPlaneCache(num_shards=2)
+    two.mark_dead(0)
+    with pytest.raises(RuntimeError, match="last alive"):
+        two.mark_dead(1)
+
+
+def test_engine_retry_rides_through_shard_failover():
+    """End to end: a dying shard's placement failures trip failover INSIDE
+    one request's retry budget — the request succeeds with zero errors
+    surfaced (the ISSUE's zero-failed-high-tier bar)."""
+    faults.set_plan(FaultPlan(shard_kill=1, shard_kill_heal_after=-1))
+    cache = ShardedPlaneCache(num_shards=2, fail_threshold=2)
+    eng = RenderEngine(cache=cache, max_bucket=8, encode_fn=_encode_fn,
+                       encode_retries=2, encode_backoff_ms=0.1)
+    iid = "c0000000aa"  # owner 1: every placement there fails
+    rgb, _ = eng.render(iid, POSE[None], image=IMG)
+    assert rgb.shape == (1, 3, HW, HW)
+    assert cache.dead_shards == [1]
+    assert iid in cache  # parked on the fallback shard
+    assert eng.sync_encodes == 1
+
+
+def test_rebalance_clears_dead_marks():
+    cache = ShardedPlaneCache(num_shards=4)
+    _ = cache.put("00000000aa", *_mpi_parts())
+    cache.mark_dead(2)
+    assert cache.dead_shards == [2]
+    cache.rebalance(2)
+    assert cache.dead_shards == []
+    assert "00000000aa" in cache
+
+
+# ---------------- rebalance racing submit ----------------
+
+def test_rebalance_races_concurrent_submits():
+    """fleet.cache.rebalance() while a thread hammers submit(): every
+    future resolves to the right shape, no exceptions, and the cache ends
+    consistent. (The cache lock serializes the topology flips against the
+    flush thread's routing/get/put.)"""
+    fleet = ServeFleet(cache_shards=4, max_requests=4, max_wait_ms=1.0,
+                       max_bucket=8)
+    fleet.engine.put("img", *_mpi_parts())
+    errors = []
+    futs = []
+
+    def hammer():
+        try:
+            for _ in range(24):
+                futs.append(fleet.submit("img", POSE))
+                time.sleep(0.001)
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    try:
+        t = threading.Thread(target=hammer)
+        t.start()
+        for n in (2, 4, 2, 4):
+            fleet.cache.rebalance(n)
+            time.sleep(0.005)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert errors == []
+        for f in futs:
+            rgb, depth = f.result(timeout=30)
+            assert rgb.shape == (3, HW, HW)
+        assert "img" in fleet.cache
+        stats = fleet.cache.stats()
+        assert stats["entries"] == 1 and stats["rebalances"] == 4
+    finally:
+        fleet.close()
+
+
+# ---------------- /healthz degraded ----------------
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+def test_healthz_reports_degraded_on_dead_shard_and_burn():
+    fleet = ServeFleet(cache_shards=2, start=False, ops_port=0,
+                       slo_objective_ms=10.0)
+    try:
+        url = fleet.ops.url + "/healthz"
+        assert _get_json(url)["status"] == "ok"
+        # a dead shard degrades health — STILL HTTP 200 (the process is
+        # up; degraded is a body field, not a probe failure)
+        fleet.cache.mark_dead(1)
+        h = _get_json(url)
+        assert h["status"] == "degraded" and h["dead_shards"] == [1]
+        fleet.cache.mark_alive(1)
+        assert _get_json(url)["status"] == "ok"
+        # error-budget burn > 1 degrades health too
+        for _ in range(4):
+            fleet.slo.record(100.0)  # all over the 10ms objective
+        h = _get_json(url)
+        assert h["status"] == "degraded"
+        assert h["error_budget_burn"] > 1.0
+        assert h["admission"] == "off"  # not enabled on this fleet
+    finally:
+        fleet.close()
+
+
+# ---------------- per-tier SLO ----------------
+
+def test_slo_snapshot_per_tier_percentiles():
+    slo = SLOTracker(objective_ms=50.0)
+    for ms in (5.0, 6.0, 7.0):
+        slo.record(ms, tier=2)
+    for ms in (80.0, 90.0):
+        slo.record(ms, tier=0)
+    slo.record(10.0)  # untiered: counted overall, absent from the table
+    snap = slo.snapshot()
+    assert snap["window_n"] == 6
+    assert set(snap["tiers"]) == {"0", "2"}
+    assert snap["tiers"]["2"]["n"] == 3
+    assert snap["tiers"]["2"]["p99_ms"] < 10.0
+    assert snap["tiers"]["0"]["p99_ms"] >= 80.0
+    # the cached burn the admission controller reads lock-free
+    assert round(slo.burn, 4) == snap["error_budget_burn"]
+
+
+# ---------------- default-off parity ----------------
+
+def test_defaults_off_bitwise_parity_with_plain_engine():
+    """Every PR-11 knob at its default: the fleet's serve path must produce
+    BITWISE-identical outputs to the plain single-device engine — admission
+    off, no deadlines, uniform default tier (the stable sort reproduces
+    FIFO exactly)."""
+    from mine_tpu.config import serve_config_from_dict
+    cfg = serve_config_from_dict({})
+    assert not cfg.admission_enabled
+    assert cfg.request_deadline_ms == 0.0 and cfg.encode_retries == 0
+    single = _engine()
+    single.put("img", *_mpi_parts())
+    fleet = ServeFleet(cache_shards=2, max_requests=4, max_wait_ms=2.0,
+                       max_bucket=8)
+    fleet.engine.put("img", *_mpi_parts())
+    assert fleet.admission is None
+    try:
+        poses = [POSE.copy() for _ in range(6)]
+        for i, p in enumerate(poses):
+            p[0, 3] = 0.01 * i
+        futs = [fleet.submit("img", p) for p in poses]
+        for p, f in zip(poses, futs):
+            rgb, depth = f.result(timeout=30)
+            ref_rgb, ref_depth = single.render("img", p[None])
+            np.testing.assert_array_equal(rgb, ref_rgb[0])
+            np.testing.assert_array_equal(depth, ref_depth[0])
+        stats = fleet.stats()
+        assert stats["shed"] == 0 and stats["degraded"] == 0
+        assert stats["expired"] == 0 and stats["dead_shards"] == []
+    finally:
+        fleet.close()
+
+
+def test_serve_config_parses_and_validates_resilience_keys():
+    from mine_tpu.config import serve_config_from_dict
+    cfg = serve_config_from_dict({
+        "serve.default_tier": 2, "serve.request_deadline_ms": 250.0,
+        "serve.encode_retries": 3, "serve.encode_backoff_ms": 5.0,
+        "serve.shard_fail_threshold": 5,
+        "serve.admission.enabled": True, "serve.admission.burn_max": 1.5,
+        "serve.admission.queue_high": 32,
+        "serve.admission.inflight_high": 128,
+        "serve.admission.shed_factor": 3.0,
+        "serve.admission.hysteresis": 0.5})
+    assert cfg.default_tier == 2 and cfg.request_deadline_ms == 250.0
+    assert cfg.encode_retries == 3 and cfg.shard_fail_threshold == 5
+    assert cfg.admission_enabled and cfg.admission_shed_factor == 3.0
+    fleet = ServeFleet.from_config(cfg, start=False)
+    try:
+        assert fleet.admission is not None
+        assert fleet.batcher.default_tier == 2
+        assert fleet.batcher.request_deadline_ms == 250.0
+        assert fleet.engine.encode_retries == 3
+        assert fleet.cache.fail_threshold == 5
+    finally:
+        fleet.close()
+    for bad in ({"serve.default_tier": -1},
+                {"serve.request_deadline_ms": -5},
+                {"serve.encode_retries": -1},
+                {"serve.shard_fail_threshold": 0},
+                {"serve.admission.shed_factor": 1.0},
+                {"serve.admission.hysteresis": 0.0}):
+        with pytest.raises(ValueError):
+            serve_config_from_dict(bad)

@@ -336,7 +336,7 @@ def test_ops_server_routes(clean_tracer):
     srv = OpsServer(port=0, registry=reg, slo=slo).start()
     try:
         code, body, ctype = _get(srv.url + "/healthz")
-        assert code == 200 and body == b"ok\n"
+        assert code == 200 and json.loads(body) == {"status": "ok"}
         code, body, ctype = _get(srv.url + "/metrics")
         assert code == 200 and "text/plain" in ctype
         assert parse_prometheus(body.decode())["mtpu_serve_reqs_total"] == 1

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Serve-side chaos soak: overload + failover against a live ServeFleet.
+
+In-process sibling of tools/chaos_soak.py for the PR-11 self-protecting
+serving layer (mine_tpu/serve/admission.py, fleet.py). One run drives a
+fleet through three phases, each behavior injected through the fault seams
+in mine_tpu/testing/faults.py — never by monkeypatching serve code:
+
+  warm      pre-encode W scenes, render a request per scene: the healthy
+            baseline every later invariant is judged against.
+  overload  FaultPlan(queue_flood=N, slow_render_ms=M): an instantaneous
+            tier-0 flood against a slowed device, with critical riders and
+            per-request deadlines on the low tiers. The admission ladder
+            must shed/degrade tier 0 while EVERY critical request renders.
+  failover  FaultPlan(shard_kill=k, shard_kill_heal_after=h): placements
+            on shard k fail until h injections -> consecutive failures mark
+            it dead, the engine's bounded encode retry rides each request
+            through re-routing, then mark_alive re-adopts the shard. Zero
+            failed requests end to end.
+
+Every line of output is "phase=<name> key=value ..." (parseable); the run
+exits NONZERO if any invariant breaks:
+
+  * a critical (tier >= 2) request sheds, expires, or errors — ever;
+  * the overload phase fails to actually overload (no shed AND no degrade
+    means the harness lost its teeth, which must be loud, not green);
+  * the failover phase ends with a dead shard un-revived, a lost entry,
+    or any failed request;
+  * the funneled event stream fails mtpu-ev1 strict validation.
+
+Usage (CPU is fine — the point is the control plane, not render speed):
+
+  JAX_PLATFORMS=cpu python tools/serve_chaos_soak.py \
+      --flood 48 --slow-render-ms 20 --events /tmp/soak_events.jsonl
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+S, HW = 4, 8
+POSE = np.eye(4, dtype=np.float32)
+
+
+def _encode_fn(img_hwc):
+    """Deterministic synthetic encoder: image bytes -> a fixed tiny MPI
+    (the soak exercises the serving control plane, not the network)."""
+    rng = np.random.RandomState(int(np.asarray(img_hwc).sum()) % 1000)
+    p = rng.uniform(-1, 1, (S, 4, HW, HW)).astype(np.float32)
+    return (p[:, 0:3], p[:, 3:4],
+            np.linspace(1.0, 0.2, S, dtype=np.float32),
+            np.eye(3, dtype=np.float32))
+
+
+def _image(seed):
+    return np.full((HW, HW, 3), float(seed), np.float32)
+
+
+def _key(shard, n, tag):
+    """An image id owned by `shard` under an `n`-way key-range partition
+    (leading 8 hex digits are the key position — serve/fleet.py)."""
+    return f"{(shard * 2 ** 32) // n + 1:08x}{tag}"
+
+
+def _settle(futs, timeout):
+    """Wait for every future; -> list of ("ok" | exception-class-name)."""
+    import concurrent.futures as cf
+    cf.wait([f for _, f in futs], timeout=timeout)
+    out = []
+    for tier, f in futs:
+        if not f.done():
+            out.append((tier, "Timeout"))
+        elif f.exception() is not None:
+            out.append((tier, type(f.exception()).__name__))
+        else:
+            out.append((tier, "ok"))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="serve-side chaos soak (overload + shard failover)")
+    ap.add_argument("--scenes", type=int, default=4)
+    ap.add_argument("--flood", type=int, default=48,
+                    help="tier-0 burst size (FaultPlan.queue_flood)")
+    ap.add_argument("--critical", type=int, default=6,
+                    help="critical riders submitted during the flood")
+    ap.add_argument("--slow-render-ms", type=int, default=20,
+                    help="injected device slowdown during the overload")
+    ap.add_argument("--deadline-ms", type=float, default=2000.0,
+                    help="per-request deadline for the flooded low tiers")
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--events", type=str, default=None,
+                    help="event-stream path (default: a temp file)")
+    args = ap.parse_args()
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from mine_tpu.serve import ServeFleet
+    from mine_tpu.serve.admission import (TIER_BEST_EFFORT, TIER_CRITICAL,
+                                          TIER_STANDARD)
+    from mine_tpu.telemetry import events as tevents
+    from mine_tpu.testing import faults
+    from mine_tpu.testing.faults import FaultPlan
+
+    events_path = args.events or os.path.join(
+        tempfile.mkdtemp(prefix="serve_soak_"), "events.jsonl")
+    tevents.reset()
+    tevents.configure(events_path)
+
+    violations = []
+
+    def check(cond, msg):
+        if not cond:
+            violations.append(msg)
+            print(f"phase=check VIOLATION {msg}", flush=True)
+
+    fleet = ServeFleet(
+        cache_shards=args.shards, max_requests=8, max_wait_ms=2.0,
+        max_bucket=8, encode_fn=_encode_fn, slo_objective_ms=5.0,
+        ops_port=0, request_deadline_ms=0.0, encode_retries=3,
+        encode_backoff_ms=5.0, shard_fail_threshold=2,
+        admission_enabled=True, admission_burn_max=0.0,
+        admission_queue_high=8, admission_inflight_high=0,
+        admission_shed_factor=2.0)
+    try:
+        # ---- phase: warm ----
+        keys = [_key(i % args.shards, args.shards, f"warm{i}")
+                for i in range(args.scenes)]
+        for i, k in enumerate(keys):
+            fleet.engine.put(k, *_encode_fn(_image(i)))
+        warm = _settle([(TIER_STANDARD, fleet.submit(k, POSE))
+                        for k in keys], args.timeout_s)
+        check(all(v == "ok" for _, v in warm),
+              f"warm renders failed: {warm}")
+        print(f"phase=warm scenes={args.scenes} "
+              f"served={sum(v == 'ok' for _, v in warm)} "
+              f"health={fleet.health()['status']}", flush=True)
+
+        # ---- phase: overload ----
+        faults.set_plan(FaultPlan(queue_flood=args.flood,
+                                  slow_render_ms=args.slow_render_ms))
+        flood_n = faults.queue_flood_n()
+        futs = []
+        for i in range(flood_n):
+            futs.append((TIER_BEST_EFFORT, fleet.submit(
+                keys[i % len(keys)], POSE, tier=TIER_BEST_EFFORT,
+                deadline_ms=args.deadline_ms)))
+            if i % max(1, flood_n // args.critical) == 0 \
+                    and sum(t >= TIER_CRITICAL for t, _ in futs) \
+                    < args.critical:
+                futs.append((TIER_CRITICAL, fleet.submit(
+                    keys[i % len(keys)], POSE, tier=TIER_CRITICAL)))
+        outcomes = _settle(futs, args.timeout_s)
+        faults.set_plan(None)
+        tally = {}
+        for tier, v in outcomes:
+            tally[v] = tally.get(v, 0) + 1
+        crit_bad = [(t, v) for t, v in outcomes
+                    if t >= TIER_CRITICAL and v != "ok"]
+        check(not crit_bad, f"critical requests failed: {crit_bad}")
+        st = fleet.stats()
+        check(st["shed"] + st["degraded"] > 0,
+              "overload produced neither shed nor degraded requests "
+              "(the harness did not create pressure)")
+        check(tally.get("Timeout", 0) == 0,
+              f"{tally.get('Timeout', 0)} futures never resolved")
+        print(f"phase=overload flood={flood_n} "
+              f"critical={sum(t >= TIER_CRITICAL for t, _ in futs)} "
+              f"served={tally.get('ok', 0)} "
+              f"shed={st['shed']} degraded={st['degraded']} "
+              f"expired={st['expired']} "
+              f"admission_state={fleet.admission.state} "
+              f"burn={fleet.health()['error_budget_burn']}", flush=True)
+
+        # ---- phase: failover ----
+        victim = 1 % args.shards
+        heal_after = fleet.cache.fail_threshold  # dies, then the seam heals
+        faults.set_plan(FaultPlan(shard_kill=victim,
+                                  shard_kill_heal_after=heal_after))
+        fo_keys = [_key(victim, args.shards, f"fo{i}") for i in range(3)]
+        fo = _settle([(TIER_STANDARD,
+                       fleet.submit(k, POSE, image=_image(90 + i)))
+                      for i, k in enumerate(fo_keys)], args.timeout_s)
+        check(all(v == "ok" for _, v in fo),
+              f"failover-phase requests failed: {fo}")
+        dead = fleet.cache.dead_shards
+        check(dead == [victim],
+              f"expected shard {victim} dead after consecutive placement "
+              f"failures, got dead={dead}")
+        resident = [k for k in fo_keys if k in fleet.cache]
+        check(len(resident) == len(fo_keys),
+              f"entries lost during failover: {set(fo_keys) - set(resident)}")
+        health_dead = fleet.health()
+        check(health_dead["status"] == "degraded",
+              f"healthz not degraded with a dead shard: {health_dead}")
+        faults.set_plan(None)
+        moved = fleet.cache.mark_alive(victim)
+        check(fleet.cache.dead_shards == [],
+              f"shard {victim} still dead after mark_alive")
+        post = _settle([(TIER_STANDARD, fleet.submit(k, POSE))
+                        for k in fo_keys], args.timeout_s)
+        check(all(v == "ok" for _, v in post),
+              f"post-revival renders failed: {post}")
+        print(f"phase=failover victim={victim} "
+              f"failovers={fleet.cache.failovers} moved={moved} "
+              f"served={sum(v == 'ok' for _, v in fo + post)} "
+              f"health={fleet.health()['status']}", flush=True)
+    finally:
+        faults.set_plan(None)
+        fleet.close()
+        tevents.reset()  # close the sink: every line on disk for validation
+
+    problems = tevents.validate_file(events_path, strict_kinds=True)
+    check(not problems, f"event stream failed strict validation: {problems}")
+    kinds = {e["kind"] for e in tevents.read_events(events_path)}
+    for want in ("serve.admission", "serve.shard_dead", "serve.shard_revive"):
+        check(want in kinds, f"expected a {want} event in the stream")
+
+    if violations:
+        print(f"phase=done SOAK FAIL violations={len(violations)}",
+              file=sys.stderr, flush=True)
+        for v in violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    print(f"phase=done SOAK OK events={events_path}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+"""Program cost/memory model: compiled-executable FLOP/byte/HBM accounting.
+
+Where analysis/flops.py counts dot_generals in the *jaxpr* (a structural
+budget), this module prices the *compiled executable*: it AOT-compiles each
+registry program via ``jit_fn.lower(*args).compile()`` and reads
+
+  * ``cost_analysis()``   — flops and bytes-accessed of the optimized HLO
+    (post-fusion, so bytes here are the real traffic estimate, unlike the
+    unfused upper bound the old tools/flops_report.py printed);
+  * ``memory_analysis()`` — argument / output / temp / alias buffer sizes,
+    from which ``peak_hbm_bytes = argument + output + temp - alias`` (alias
+    bytes are donated-input space the output reuses, counted once).
+
+These numbers are deterministic per (program, jax version, platform), so
+the ``cost_budget`` audit pass pins them exactly in the ``"cost"`` section
+of tools/analysis_baseline.json with the same update discipline as the dot
+budgets: a change in EITHER direction fails until `tools/audit.py
+--update-baseline` re-records them in the same commit as the intentional
+program change. This is the HBM-fit oracle the ROADMAP's MPMD-pipeline and
+AOT-cold-start items need: "does this program's working set fit one chip"
+becomes a table lookup instead of an OOM on silicon.
+
+The roofline estimate prices a program against a chip model given
+``MINE_TPU_BENCH_PEAK_TFLOPS`` (bench.py's knob, v5e bf16 default) and
+``MINE_TPU_BENCH_HBM_GBPS``: expected step time is the max of the compute
+and memory legs, and the binding leg names the bottleneck. Env-dependent,
+so it is *reported* (pass details, flops_report) but never baseline-gated.
+
+tools/flops_report.py is now a thin CLI shim over `attribution_report`
+below (same precedent as tools/dtype_audit.py -> analysis/dtype.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Optional
+
+# keys pinned per program in analysis_baseline.json's "cost" section;
+# append-only (removing or renaming one invalidates every checked-in entry)
+COST_KEYS = ("flops", "bytes_accessed", "argument_bytes", "output_bytes",
+             "temp_bytes", "alias_bytes", "peak_hbm_bytes")
+
+# chip model defaults: v5e bf16 peak (bench.py's CHIP_PEAK_TFLOPS default)
+# and v5e HBM bandwidth. Both overridable via the bench env knobs.
+DEFAULT_PEAK_TFLOPS = 197.0
+DEFAULT_HBM_GBPS = 819.0
+
+
+def chip_model() -> Dict[str, float]:
+    """The (peak TFLOP/s, HBM GB/s) pair the roofline prices against."""
+    return {
+        "peak_tflops": float(os.environ.get("MINE_TPU_BENCH_PEAK_TFLOPS",
+                                            DEFAULT_PEAK_TFLOPS)),
+        "hbm_gbps": float(os.environ.get("MINE_TPU_BENCH_HBM_GBPS",
+                                         DEFAULT_HBM_GBPS)),
+    }
+
+
+def _unwrap_cost_analysis(compiled) -> Dict:
+    """jax 0.4.x returns one properties-dict per partition as a list;
+    newer versions return the dict directly. Normalize to the dict."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def compiled_cost(jit_fn, args) -> Dict[str, int]:
+    """AOT-compile ``jit_fn(*args)`` and return the pinned cost dict
+    (COST_KEYS). Works on CPU: XLA's cost and buffer-assignment analyses
+    run on the optimized HLO regardless of backend."""
+    compiled = jit_fn.lower(*args).compile()
+    ca = _unwrap_cost_analysis(compiled)
+    ma = compiled.memory_analysis()
+    arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+    return {
+        "flops": int(ca.get("flops", 0) or 0),
+        "bytes_accessed": int(ca.get("bytes accessed", 0) or 0),
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "peak_hbm_bytes": arg + out + temp - alias,
+    }
+
+
+def measure_program(program) -> Dict[str, int]:
+    """`compiled_cost` over a registry Program's canonical arguments."""
+    return compiled_cost(program.jit_fn, program.args_fn())
+
+
+def roofline(cost: Dict[str, int],
+             peak_tflops: Optional[float] = None,
+             hbm_gbps: Optional[float] = None) -> Dict[str, object]:
+    """Two-leg roofline: expected time is max(flops/peak, bytes/bandwidth),
+    the binding leg is the bottleneck, and arithmetic intensity (flops per
+    byte accessed) tells how far from the ridge the program sits."""
+    chip = chip_model()
+    peak = peak_tflops if peak_tflops is not None else chip["peak_tflops"]
+    bw = hbm_gbps if hbm_gbps is not None else chip["hbm_gbps"]
+    compute_ms = cost["flops"] / (peak * 1e12) * 1e3
+    memory_ms = cost["bytes_accessed"] / (bw * 1e9) * 1e3
+    expected_ms = max(compute_ms, memory_ms)
+    return {
+        "compute_ms": compute_ms,
+        "memory_ms": memory_ms,
+        "expected_ms": expected_ms,
+        "bound": "compute" if compute_ms >= memory_ms else "memory",
+        "intensity_flops_per_byte": (
+            cost["flops"] / cost["bytes_accessed"]
+            if cost["bytes_accessed"] else float("inf")),
+        "peak_tflops": peak,
+        "hbm_gbps": bw,
+    }
+
+
+# ------------------------------------------------- flops_report attribution
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def attribution_report(argv=None) -> None:
+    """The original tools/flops_report.py body, relocated verbatim in
+    behavior: static per-component cost attribution at the benchmark
+    config, human table on stderr, JSON on stdout under --json. Uses the
+    *lowered* (unfused) cost_analysis deliberately — its bytes column is
+    the labeled upper bound the historical reports printed."""
+    import json
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from tools import microbench
+
+    argv = sys.argv if argv is None else argv
+    rows = {}
+
+    def add(name, fn, *args):
+        ca = jax.jit(fn).lower(*args).cost_analysis()
+        rows[name] = {
+            "tflops": round(ca.get("flops", float("nan")) / 1e12, 4),
+            "gbytes_unfused_upper_bound": round(
+                ca.get("bytes accessed", float("nan")) / 1e9, 2),
+        }
+        print("%-28s %8.4f TFLOP   %8.2f GB (unfused upper bound)"
+              % (name, rows[name]["tflops"],
+                 rows[name]["gbytes_unfused_upper_bound"]), file=sys.stderr)
+
+    # full train step at the benchmark's headline variant (shared builder:
+    # this attribution is of exactly the benchmarked program)
+    trainer, state, batch = bench.build_variant_program("xla_b4")
+    add("train_step_b4", trainer._train_step_impl, state, batch)
+
+    # isolated components at the microbench shapes (B=2, S=32, 256x384)
+    for case in ("encoder_fwd", "model_fwd", "warp_xla_fwd",
+                 "warp_xla_fwdbwd", "comp_xla_fwd", "comp_xla_fwdbwd"):
+        fn, args = microbench._case_fn(case)
+        add(case + "_b2", fn, *args)
+
+    step = rows["train_step_b4"]["tflops"]
+    out = {
+        "config": "LLFF 384x256 N=32 bf16 ResNet-50 (bench.py)",
+        "components": rows,
+        "peak_bound_images_per_sec": {
+            "v5e_bf16_peak_tflops": V5E_BF16_PEAK_TFLOPS,
+            "at_100pct_mxu": round(4 * V5E_BF16_PEAK_TFLOPS / step, 1),
+            "at_40pct_mxu": round(0.4 * 4 * V5E_BF16_PEAK_TFLOPS / step, 1),
+        },
+    }
+    # stdout JSON only under --json; the human-readable table already went
+    # to stderr line by line via add()
+    if "--json" in argv:
+        print(json.dumps(out, indent=2))
+    else:
+        pb = out["peak_bound_images_per_sec"]
+        print("peak-bound img/s: %.1f @100%% MXU, %.1f @40%% (v5e %.0f TFLOP/s)"
+              % (pb["at_100pct_mxu"], pb["at_40pct_mxu"],
+                 pb["v5e_bf16_peak_tflops"]), file=sys.stderr)

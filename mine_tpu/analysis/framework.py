@@ -93,7 +93,8 @@ def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict:
     the budget pass can say 'run --update-baseline' per program instead of
     crashing the whole audit."""
     if not os.path.exists(path):
-        return {"schema": BASELINE_SCHEMA, "programs": {}, "budgets": {}}
+        return {"schema": BASELINE_SCHEMA, "programs": {}, "budgets": {},
+                "cost": {}}
     with open(path) as f:
         data = json.load(f)
     if data.get("schema") != BASELINE_SCHEMA:
@@ -102,6 +103,10 @@ def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Dict:
             f"(expected {BASELINE_SCHEMA!r})")
     data.setdefault("programs", {})
     data.setdefault("budgets", {})
+    # compiled-executable cost/memory budgets (costmodel.py) live in their
+    # own section: the dot_budget pass diffs the full key set of each
+    # "programs" entry, so cost keys must not leak into it
+    data.setdefault("cost", {})
     return data
 
 

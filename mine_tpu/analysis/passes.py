@@ -2,6 +2,9 @@
 
   dtype_upcast      bf16->f32 converts inside conv-stack scopes (StableHLO)
   dot_budget        dot_general count / FLOPs vs tools/analysis_baseline.json
+  cost_budget       compiled-executable flops/bytes/HBM vs the baseline's
+                    "cost" section (analysis/costmodel.py), with a roofline
+                    expected-time estimate in the details
   recompile_churn   a second identically-shaped call must hit the jit cache
   transfer_guard    hot paths run clean under jax.transfer_guard("disallow")
   donation          donated buffers actually consumed (deleted, no warning)
@@ -27,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from mine_tpu.analysis import costmodel as _costmodel
 from mine_tpu.analysis import dtype as _dtype
 from mine_tpu.analysis import flops as _flops
 from mine_tpu.analysis import locks as _locks
@@ -131,6 +135,71 @@ class DotBudgetPass(AuditPass):
                        args_fn=lambda: (x, y))
         seeded = DotBudgetPass(
             {"programs": {"selftest[budget]": {"dots": 0, "dot_flops": 0}}})
+        return seeded.run(prog)
+
+
+# -------------------------------------------------------------- cost budget
+
+class CostBudgetPass(AuditPass):
+    """Compiled-executable cost/memory budget: AOT-compile each program and
+    pin cost_analysis() flops/bytes plus memory_analysis() argument/output/
+    temp/alias/peak-HBM bytes, exactly, in the baseline's "cost" section.
+    These are post-fusion numbers — the real traffic and residency of the
+    program XLA actually runs — so any drift means the generated code
+    changed; update with `tools/audit.py --update-baseline` in the same
+    commit as the intentional change. Details carry the roofline estimate
+    (env-dependent chip model, reported but never gated)."""
+
+    name = "cost_budget"
+
+    def __init__(self, baseline: Dict):
+        self.baseline = baseline
+
+    def measure(self, program) -> Dict:
+        return _costmodel.measure_program(program)
+
+    def run(self, program) -> PassResult:
+        measured = self.measure(program)
+        expected = self.baseline.get("cost", {}).get(program.name)
+        if expected is None:
+            return self._result(
+                program, ok=False,
+                details="no cost baseline entry — run tools/audit.py "
+                        "--update-baseline on a green build",
+                measured=measured)
+        diffs = [f"{k}: measured {measured[k]} != baseline {expected[k]}"
+                 for k in sorted(set(measured) | set(expected))
+                 if measured.get(k) != expected.get(k)]
+        if diffs:
+            return self._result(program, ok=False,
+                                details="; ".join(diffs),
+                                measured=measured, expected=expected)
+        rl = _costmodel.roofline(measured)
+        det = (f"flops={measured['flops']} "
+               f"bytes={measured['bytes_accessed']} "
+               f"peak_hbm={measured['peak_hbm_bytes']}; "
+               f"roofline {rl['expected_ms']:.3f} ms "
+               f"({rl['bound']}-bound @ {rl['peak_tflops']:.0f} TFLOP/s, "
+               f"{rl['hbm_gbps']:.0f} GB/s)")
+        return self._result(program, ok=True, details=det,
+                            measured=measured, roofline=rl)
+
+    def selftest(self) -> PassResult:
+        from mine_tpu.analysis.programs import Program
+
+        def mm(a, b):
+            return a @ b
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        y = jnp.zeros((8, 2), jnp.float32)
+        prog = Program(name="selftest[cost]", jit_fn=jax.jit(mm),
+                       args_fn=lambda: (x, y))
+        # seeded violation: an inflated flops entry the measurement can
+        # never reproduce — the exact-match gate must fail on it
+        seeded = CostBudgetPass({"cost": {"selftest[cost]": {
+            "flops": 10 ** 15, "bytes_accessed": 0, "argument_bytes": 0,
+            "output_bytes": 0, "temp_bytes": 0, "alias_bytes": 0,
+            "peak_hbm_bytes": 0}}})
         return seeded.run(prog)
 
 
@@ -406,12 +475,13 @@ class ConcurrencyPass(AuditPass):
 
 def default_passes(baseline: Dict) -> List[AuditPass]:
     return [DtypeUpcastPass(), DotBudgetPass(baseline),
-            RecompileChurnPass(), TransferGuardPass(), DonationPass(),
-            ConcurrencyPass()]
+            CostBudgetPass(baseline), RecompileChurnPass(),
+            TransferGuardPass(), DonationPass(), ConcurrencyPass()]
 
 
 def pass_by_name(name: str, baseline: Optional[Dict] = None) -> AuditPass:
-    for p in default_passes(baseline or {"programs": {}, "budgets": {}}):
+    for p in default_passes(baseline or {"programs": {}, "budgets": {},
+                                         "cost": {}}):
         if p.name == name:
             return p
     raise KeyError(f"unknown pass {name!r}")

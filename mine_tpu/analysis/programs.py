@@ -118,6 +118,9 @@ def _tiny_trainer(dtype: str = "bfloat16"):
         # audit the portable program, not a TPU-only lowering
         "training.warp_backend": "xla",
         "training.composite_backend": "xla",
+        # audit the telemetry-enabled step: the transfer_guard pass staying
+        # green here is the proof that per-layer stats add no host syncs
+        "training.layer_stats": True,
     })
     trainer = SynthesisTrainer(config, steps_per_epoch=10_000)
     state_host = _host_tree(trainer.init_state(batch_size=t["batch"]))

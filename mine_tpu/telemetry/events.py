@@ -191,6 +191,7 @@ def reset() -> None:
 # appear; they become pinned once documented here).
 KIND_FIELDS: Dict[str, tuple] = {
     "train.step": ("gstep", "step_ms"),
+    "train.layers": ("gstep", "groups"),
     "span": ("name", "ms"),
     "trace.span": ("trace", "span", "name", "ms", "t_off_ms"),
     "serve.sync_encode": ("image_id",),

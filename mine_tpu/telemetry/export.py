@@ -21,6 +21,11 @@ round-trip through (it validates the grammar we emit, not the full spec).
                     shard is marked dead
     /slo            rolling-window SLO snapshot (telemetry/slo.py), JSON
     /traces/recent  last completed traces (telemetry/tracing.py), JSON
+    /progress       with a `progress` callable wired (the train loop's —
+                    train/loop.py behind `training.ops_port`), that
+                    callable's dict: step/epoch position plus an ETA
+                    derived from the recent st1 step-time history; 404
+                    when no callable is wired
 
 Port 0 binds an ephemeral port (tests read `.port`). Everything here is
 host-side and stdlib-only; request handling never touches jax state — the
@@ -126,7 +131,8 @@ class OpsServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[_registry.MetricsRegistry] = None,
-                 slo=None, traces_limit: int = 32, health=None):
+                 slo=None, traces_limit: int = 32, health=None,
+                 progress=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         ops = self
@@ -137,6 +143,8 @@ class OpsServer:
         # optional () -> dict with at least a "status" key; None = bare
         # liveness (the process answering IS the health signal)
         self.health = health
+        # optional () -> dict for /progress (step/epoch/ETA); None = 404
+        self.progress = progress
 
         class _Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes,
@@ -164,6 +172,9 @@ class OpsServer:
                     elif path == "/traces/recent":
                         traces = _tracing.recent(ops.traces_limit)
                         body = json.dumps({"traces": traces}) + "\n"
+                        self._send(200, body.encode())
+                    elif path == "/progress" and ops.progress is not None:
+                        body = json.dumps(ops.progress()) + "\n"
                         self._send(200, body.encode())
                     else:
                         self._send(404, b'{"error": "not found"}\n')

@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
+from collections import deque
 from typing import Dict, Optional
 
 import jax
@@ -144,6 +145,22 @@ class TrainLoop:
             self.telem.profile_dir or os.path.join(workspace, "profile"),
             logger)
 
+        # --- train-side ops plane (training.ops_port, default off) ---
+        # The serve stack's OpsServer reused for training: /metrics (the
+        # shared registry), /healthz (degraded on a live guard-skip streak
+        # or data errors burning in the last log interval), /progress
+        # (step/epoch position + ETA from the st1 step-time history). Lead
+        # host only. The handlers read only this host-side state dict,
+        # which is written at log cadence — the server can never add a
+        # device sync, and with the port at 0 nothing is constructed, so
+        # training outputs are bitwise identical on vs off.
+        self.ops_port = int(self.config.get("training.ops_port", 0) or 0)
+        self._ops = None
+        self._step_hist = deque(maxlen=64)  # recent step_ms, log cadence
+        self._ops_state = {"gstep": 0, "epoch": 0, "epochs": 0,
+                           "guard_consecutive": 0.0, "data_errors": 0,
+                           "data_errors_delta": 0}
+
         self.serve_cfg = serve_config_from_dict(self.config)
         self.eval_encode_once = bool(self.serve_cfg.eval_encode_once)
         if self.eval_encode_once:
@@ -178,6 +195,14 @@ class TrainLoop:
         steps_per_epoch = self.trainer.steps_per_epoch
         start_epoch = int(state.step) // steps_per_epoch + 1
 
+        self._ops_state.update(epochs=epochs, gstep=int(state.step),
+                               epoch=start_epoch)
+        if self.ops_port and self.is_lead:
+            self._ops = telemetry.OpsServer(
+                port=self.ops_port, health=self._train_health,
+                progress=self._train_progress).start()
+            self._log("train ops endpoint at %s" % self._ops.url)
+
         self.preempt.install()
         try:
             for epoch in range(start_epoch, epochs + 1):
@@ -208,6 +233,9 @@ class TrainLoop:
         finally:
             self.preempt.uninstall()
             self.profile.stop()  # a window whose stop step never arrived
+            if self._ops is not None:
+                self._ops.close()  # join before the thread-leak tripwire
+                self._ops = None
             # one end-of-run registry snapshot into the event stream so
             # obs_report sees final counter values without scraping logs
             telemetry.emit(
@@ -571,9 +599,53 @@ class TrainLoop:
                     "tensorboard writer failed — disabling TB output for "
                     "the rest of the run", exc_info=True)
 
+    # ---------------- train-side ops plane ----------------
+
+    def _train_health(self):
+        """/healthz body: "degraded" while the non-finite guard is in a
+        live skip streak or data errors burned in the last log interval.
+        Reads only the log-cadence state dict — never a device value."""
+        s = self._ops_state
+        reasons = []
+        if s["guard_consecutive"] > 0:
+            reasons.append("guard skip streak: %d consecutive "
+                           "non-finite steps" % int(s["guard_consecutive"]))
+        if s["data_errors_delta"] > 0:
+            reasons.append("%d data errors in the last log interval"
+                           % int(s["data_errors_delta"]))
+        return {"status": "degraded" if reasons else "ok",
+                "reasons": reasons, "gstep": int(s["gstep"]),
+                "data_errors": int(s["data_errors"])}
+
+    def _train_progress(self):
+        """/progress body: position plus an ETA extrapolated from the
+        recent st1 step_ms history (None until the first log interval)."""
+        s = self._ops_state
+        total = int(s["epochs"]) * self.trainer.steps_per_epoch
+        avg_ms = (sum(self._step_hist) / len(self._step_hist)
+                  if self._step_hist else None)
+        remaining = max(0, total - int(s["gstep"]))
+        return {"gstep": int(s["gstep"]), "epoch": int(s["epoch"]),
+                "epochs": int(s["epochs"]),
+                "steps_per_epoch": self.trainer.steps_per_epoch,
+                "total_steps": total,
+                "step_ms_avg": None if avg_ms is None else round(avg_ms, 3),
+                "eta_s": None if avg_ms is None
+                else round(remaining * avg_ms / 1e3, 1)}
+
     def _log_training(self, epoch, step, gstep, m, times):
         lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
         data_stats = PIPELINE_STATS.snapshot()
+        # ops-plane state: written only here (log cadence, lead host), read
+        # by the /healthz and /progress handlers
+        self._step_hist.append(times["step_ms"])
+        prev_errors = self._ops_state["data_errors"]
+        self._ops_state.update(
+            gstep=gstep, epoch=epoch,
+            guard_consecutive=m.get("guard_consecutive", 0.0),
+            data_errors=data_stats["data_errors"],
+            data_errors_delta=max(
+                0, data_stats["data_errors"] - prev_errors))
         # the FROZEN parseable step-time line (schema st1 — see
         # telemetry/stepline.py; tools/step_breakdown.py and obs_report
         # both read it through the one shared parser)
@@ -609,6 +681,22 @@ class TrainLoop:
                 psnr_tgt=round(float(m.get("psnr_tgt", 0.0)), 4),
                 **{k: round(times[k], 3) for k in TIME_METER_KEYS},
                 data_errors=data_stats["data_errors"])
+            # per-layer-group stats (training.layer_stats): the jitted step
+            # returns them as "layers/<group>.<stat>" scalar metrics — they
+            # arrived in the same log-cadence readback as everything else.
+            # Regrouped into one train.layers event + registry histograms.
+            layer_groups: Dict[str, Dict[str, float]] = {}
+            for k in m:
+                if not k.startswith("layers/"):
+                    continue
+                group, stat = k[len("layers/"):].split(".", 1)
+                layer_groups.setdefault(group, {})[stat] = \
+                    round(float(m[k]), 6)
+                telemetry.histogram(
+                    "train.layers." + k[len("layers/"):]).record(m[k])
+            if layer_groups:
+                telemetry.emit("train.layers", gstep=gstep,
+                               groups=layer_groups)
         for k, meter in self.time_meters.items():
             meter.update(times[k])
             self._tb("add_scalar", "time/" + k, times[k], gstep)

@@ -142,6 +142,15 @@ class SynthesisTrainer:
         # surface through the (already log-cadence-synced) metrics.
         self.guard_nonfinite = bool(config.get("training.guard_nonfinite",
                                                True))
+        # Per-layer-group training telemetry (training.layer_stats, default
+        # off): per-group grad norms, update-to-weight ratios, and plane
+        # alpha distribution summaries, computed INSIDE the jitted step as
+        # scalar metrics. They ride the existing log-cadence metrics
+        # readback — zero additional host syncs (the transfer_guard audit
+        # pass runs with this enabled), and no new dot_generals (norms and
+        # moments are elementwise + reductions), so dot budgets are
+        # unchanged.
+        self.layer_stats = bool(config.get("training.layer_stats", False))
         # Fault injection is resolved at TRACE time (set the plan before
         # constructing the trainer): None in production, so the injected
         # jnp.where never enters the compiled program.
@@ -287,6 +296,27 @@ class SynthesisTrainer:
                 train=True)
             total, metrics, _ = compute_losses(
                 mpi_list, disparity_all, batch, self.cfg, mesh=self.mesh)
+            if self.layer_stats:
+                # plane content health at the full-resolution scale: alpha
+                # collapse (everything transparent/opaque) is the classic
+                # silent MPI failure mode — [B,S,4,h,w], channel 3 = alpha.
+                # optimization_barrier keeps the stat reductions from
+                # CSE/fusing with the loss graph: the numeric step must be
+                # bitwise-identical with layer_stats on or off
+                with jax.named_scope("layer_stats_planes"):
+                    # stop_gradient lowers the AD tracer to its primal
+                    # (optimization_barrier has no differentiation rule)
+                    mpi0 = jax.lax.optimization_barrier(
+                        jax.lax.stop_gradient(mpi_list[0]))
+                    alpha = mpi0[:, :, 3].astype(jnp.float32)
+                    metrics = dict(
+                        metrics,
+                        **{"layers/planes.alpha_mean": jnp.mean(alpha),
+                           "layers/planes.alpha_std": jnp.std(alpha),
+                           "layers/planes.alpha_sat_lo":
+                               jnp.mean((alpha < 0.01).astype(jnp.float32)),
+                           "layers/planes.alpha_sat_hi":
+                               jnp.mean((alpha > 0.99).astype(jnp.float32))})
             return total, (metrics, new_stats)
 
         (_, (metrics, new_stats)), grads = jax.value_and_grad(
@@ -337,6 +367,34 @@ class SynthesisTrainer:
                                skipped_steps=skipped,
                                guard_consecutive=consec,
                                guard_last_bad_step=last_bad)
+        if self.layer_stats:
+            # per-top-level-group (backbone / decoder) optimization health:
+            # grad norm, and the update-to-weight ratio that flags a group
+            # whose effective learning rate has gone degenerate. Scalars
+            # only — they merge into the metrics dict and reach the host
+            # exclusively through the log-cadence readback. Placement is
+            # deliberate: the numeric step must be bitwise-identical with
+            # layer_stats on or off, so the norms only touch values that
+            # are materialized either way — grads (whose per-leaf square
+            # sums CSE with the nonfinite guard's global norm), the input
+            # params, and the POST-guard new_params that the step returns.
+            # Consuming the optax `updates` tree (or the pre-guard
+            # new_params) re-fuses the adam update and drifts a leaf, so
+            # the applied-update norm is taken as ||new - old|| instead —
+            # which also truthfully reads 0 on a guard-skipped step.
+            with jax.named_scope("layer_stats_groups"):
+                layer_metrics = {}
+                for group in state.params:
+                    gn = optax.global_norm(grads[group])
+                    un = optax.global_norm(jax.tree_util.tree_map(
+                        lambda n, o: n - o, new_params[group],
+                        state.params[group]))
+                    wn = optax.global_norm(state.params[group])
+                    layer_metrics[f"layers/{group}.grad_norm"] = gn
+                    layer_metrics[f"layers/{group}.param_norm"] = wn
+                    layer_metrics[f"layers/{group}.update_ratio"] = \
+                        un / (wn + 1e-12)
+                metrics = dict(metrics, **layer_metrics)
         new_state = TrainState(step=state.step + 1,
                                params=new_params,
                                batch_stats=new_stats,

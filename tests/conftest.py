@@ -38,6 +38,7 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # ---------------------------------------------------------------------------
 
 QUICK = {
+    "test_bench_conductor.py::test_judge_verdicts",
     "test_bench_watchdog.py::test_physics_audit_rejects_above_peak_readings",
     "test_chaos.py::test_fault_plan_spec_env_and_config",
     "test_checkpoint.py::test_restore_missing_returns_none",

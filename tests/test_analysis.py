@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from mine_tpu.analysis import costmodel
 from mine_tpu.analysis import flops as flops_mod
 from mine_tpu.analysis import locks
 from mine_tpu.analysis import passes as passes_mod
@@ -194,13 +195,27 @@ def test_baseline_roundtrip_and_schema_gate(tmp_path):
     path = str(tmp_path / "b.json")
     missing = load_baseline(path)
     assert missing["programs"] == {} and missing["schema"] == BASELINE_SCHEMA
+    assert missing["cost"] == {}
     missing["programs"]["p"] = {"dots": 3}
+    missing["cost"]["p"] = {"flops": 128, "peak_hbm_bytes": 224}
     save_baseline(missing, path)
-    assert load_baseline(path)["programs"]["p"] == {"dots": 3}
+    again = load_baseline(path)
+    assert again["programs"]["p"] == {"dots": 3}
+    assert again["cost"]["p"] == {"flops": 128, "peak_hbm_bytes": 224}
     with open(path, "w") as f:
         json.dump({"schema": "other"}, f)
     with pytest.raises(ValueError, match="schema"):
         load_baseline(path)
+
+
+def test_baseline_without_cost_section_gets_empty_one(tmp_path):
+    """A pre-PR-12 baseline file (no 'cost' key) loads with an empty cost
+    section instead of KeyError-ing every CostBudgetPass lookup."""
+    path = str(tmp_path / "old.json")
+    with open(path, "w") as f:
+        json.dump({"schema": BASELINE_SCHEMA, "programs": {},
+                   "budgets": {}}, f)
+    assert load_baseline(path)["cost"] == {}
 
 
 def test_checked_in_baseline_covers_all_programs():
@@ -214,6 +229,14 @@ def test_checked_in_baseline_covers_all_programs():
     for key in ("fused_loss.blur_dots", "fused_loss.blur_dots_reference",
                 "warp.separable_vs_banded_max_flop_ratio"):
         assert key in baseline["budgets"]
+    # cost side of the ledger: every program pinned, every key present
+    missing_cost = set(program_names()) - set(baseline["cost"])
+    assert not missing_cost, (
+        f"programs without a cost baseline entry: {missing_cost}")
+    for name, entry in baseline["cost"].items():
+        assert set(entry) == set(costmodel.COST_KEYS), (
+            f"{name}: cost keys drifted from COST_KEYS — regenerate with "
+            f"tools/audit.py --update-baseline")
 
 
 def test_format_report_counts_failures():
@@ -244,8 +267,8 @@ def test_run_audit_survives_crashing_pass():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("pass_name", [
-    "dtype_upcast", "dot_budget", "recompile_churn", "transfer_guard",
-    "donation", "concurrency"])
+    "dtype_upcast", "dot_budget", "cost_budget", "recompile_churn",
+    "transfer_guard", "donation", "concurrency"])
 def test_pass_selftest_detects_seeded_violation(pass_name):
     p = passes_mod.pass_by_name(pass_name)
     r = p.selftest()
@@ -328,3 +351,55 @@ def test_concurrency_pass_clean_on_live_workload():
     order violation or a leaked thread."""
     r = passes_mod.ConcurrencyPass().run_global()
     assert r.ok, r.details
+
+
+# ---------------------------------------------------------------------------
+# compiled cost/memory model (analysis/costmodel.py, the cost_budget pass)
+# ---------------------------------------------------------------------------
+
+def test_compiled_cost_tiny_matmul_keys_and_bound():
+    m, k, n = 8, 16, 4
+    cost = costmodel.compiled_cost(
+        jax.jit(lambda a, b: a @ b),
+        (jnp.zeros((m, k), jnp.float32), jnp.zeros((k, n), jnp.float32)))
+    assert set(cost) == set(costmodel.COST_KEYS)
+    assert cost["flops"] == 2 * m * k * n
+    assert all(v >= 0 for v in cost.values())
+    # no donation here, so alias=0 and peak is exactly arg+out+temp
+    assert cost["alias_bytes"] == 0
+    assert cost["peak_hbm_bytes"] >= (cost["argument_bytes"]
+                                      + cost["output_bytes"])
+
+
+def test_roofline_picks_the_binding_resource():
+    # 1 TFLOP at 1 byte: compute-bound; expected time = flops / peak
+    c = costmodel.roofline({"flops": 10**12, "bytes_accessed": 1},
+                           peak_tflops=1.0, hbm_gbps=1000.0)
+    assert c["bound"] == "compute"
+    assert c["expected_ms"] == pytest.approx(1000.0)
+    # 1 flop over 1 GB: memory-bound; expected time = bytes / bandwidth
+    m = costmodel.roofline({"flops": 1, "bytes_accessed": 10**9},
+                           peak_tflops=1.0, hbm_gbps=1.0)
+    assert m["bound"] == "memory"
+    assert m["expected_ms"] == pytest.approx(1000.0)
+    assert m["expected_ms"] == max(m["compute_ms"], m["memory_ms"])
+
+
+@pytest.mark.slow
+def test_cost_peak_hbm_bound_on_real_train_step():
+    """On the real donated train step, peak HBM must still cover the live
+    argument+output working set — the donation alias discount can never
+    push the model below what the arrays themselves occupy. Also pins the
+    measurement against the checked-in baseline (same CPU determinism the
+    gate relies on). Slow tier: ~35s AOT compile the in-window audit
+    --gate cost_budget pass already performs and exact-gates."""
+    from mine_tpu.analysis.programs import get_program
+    prog = get_program("train_step")
+    cost = costmodel.measure_program(prog)
+    assert cost["peak_hbm_bytes"] >= (cost["argument_bytes"]
+                                      + cost["output_bytes"])
+    assert cost["alias_bytes"] > 0  # state donation actually aliases
+    expected = load_baseline()["cost"]["train_step"]
+    assert cost == expected, (
+        "compiled train_step cost drifted from tools/analysis_baseline.json"
+        " — rerun tools/audit.py --update-baseline and review the diff")

@@ -575,3 +575,41 @@ def test_sigkill_resume_is_bitwise_deterministic(tmp_path):
     leg("chaos_ws", "chaos.txt")  # relaunch: resumes from the workspace
     chaos = chaos_soak.read_trace(str(tmp_path / "chaos.txt"))
     assert chaos == ref  # bitwise: repr'd losses, last occurrence per step
+
+
+# ---------------------------------------------------------------------------
+# serve-side chaos soak harness (tools/serve_chaos_soak.py; subprocess; slow)
+# ---------------------------------------------------------------------------
+
+def _soak(tmp_path, *extra):
+    tools = os.path.join(os.path.dirname(__file__), "..", "tools")
+    events = str(tmp_path / "soak_events.jsonl")
+    cmd = [sys.executable, os.path.join(tools, "serve_chaos_soak.py"),
+           "--scenes", "2", "--shards", "2", "--critical", "2",
+           "--events", events, *extra]
+    proc = subprocess.run(cmd, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                          capture_output=True, text=True, timeout=600)
+    return proc, events
+
+
+@pytest.mark.slow
+def test_serve_chaos_soak_smoke_passes(tmp_path):
+    """A tiny 2-shard soak drives the full storm (flood + kill + revive)
+    and exits 0 with a valid mtpu-ev1 event stream — CI proof the serve
+    chaos harness itself still works, not just its unit-tested parts."""
+    from mine_tpu.telemetry import events as tevents
+    proc, events = _soak(tmp_path, "--flood", "24", "--slow-render-ms", "10")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SOAK OK" in proc.stdout
+    assert not tevents.validate_file(events)
+
+
+@pytest.mark.slow
+def test_serve_chaos_soak_seeded_violation_fails(tmp_path):
+    """De-fanged storm (one request, instant renders) creates no overload,
+    so the 'harness must create pressure' invariant trips and the soak
+    exits nonzero — proof the gate can actually fail."""
+    proc, _ = _soak(tmp_path, "--flood", "1", "--slow-render-ms", "0")
+    assert proc.returncode != 0, (
+        "soak passed with no pressure — the harness lost its teeth")
+    assert "SOAK FAIL" in proc.stderr

@@ -127,6 +127,33 @@ def test_report_slo_breach_section():
     assert "p99=120.0 ms over objective=50.0 ms" in text
 
 
+def test_report_resilience_section():
+    events = [
+        _ev("serve.admission", state="degrade", prev="admit", score=1.2,
+            queue_depth=9, inflight=4),
+        _ev("serve.admission", state="admit", prev="degrade", score=0.3,
+            queue_depth=1, inflight=1),
+        _ev("serve.shard_dead", shard=1, shards=4, failures=3, dropped=7),
+        _ev("serve.shard_revive", shard=1, shards=4, moved=5),
+        _ev("metrics.snapshot", scope="serve",
+            metrics={"serve.admission.shed": 6,
+                     "serve.admission.degraded": 2,
+                     "serve.batcher.expired": 1}),
+    ]
+    text = obs_report.report(events, [])
+    assert "resilience (admission control + shard failover):" in text
+    assert "admission transitions (2): admit=1 degrade=1" in text
+    assert "score=1.2" in text and "inflight=4" in text
+    assert "load-shedding totals: shed=6 degraded=2 expired=1" in text
+    assert "shard 1 DEAD after 3 failure(s), dropped 7" in text
+    assert "shard 1 revived, remapped 5" in text
+
+
+def test_report_resilience_section_absent_without_its_events():
+    text = obs_report.report([_ev("span", name="x", ms=1.0)], [])
+    assert "resilience" not in text
+
+
 # ---------------- schema-drift tripwire (validate_events --strict) -------
 
 _EXEMPLAR_VALUES = {

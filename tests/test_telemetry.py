@@ -296,6 +296,7 @@ def test_log_training_emits_st1_line_and_registry(tmp_path, clean_sink):
     tevents.configure(str(tmp_path / "ev.jsonl"))
     telemetry.REGISTRY.reset()
     logged = []
+    from collections import deque
     stub = SimpleNamespace(
         config=tiny_config(),
         trainer=SimpleNamespace(steps_per_epoch=10),
@@ -303,6 +304,10 @@ def test_log_training_emits_st1_line_and_registry(tmp_path, clean_sink):
         time_meters={k: AverageMeter("time_" + k, ":.1f")
                      for k in TIME_METER_KEYS},
         train_meters={},
+        _step_hist=deque(maxlen=64),  # ops-plane state (PR 12)
+        _ops_state={"gstep": 0, "epoch": 0, "epochs": 0,
+                    "guard_consecutive": 0.0, "data_errors": 0,
+                    "data_errors_delta": 0},
         _log=lambda msg, *a: logged.append(msg % a if a else msg),
         _tb=lambda *a: None)
     m = {"loss": 1.5, "loss_rgb_src": 0.1, "loss_ssim_src": 0.2,
@@ -441,5 +446,9 @@ def test_serve_slo_smoke_emits_parseable_curve(tmp_path):
     # the knee qps _measure returned (printed to stdout) is positive
     assert float(out.stdout.splitlines()[-1]) > 0
     assert tevents.validate_file(events) == []
-    assert sum(1 for e in tevents.read_events(events)
-               if e["kind"] == "serve.slo_point") == 5
+    points = [e for e in tevents.read_events(events)
+              if e["kind"] == "serve.slo_point"]
+    # 5 curve points plus the ONE deliberate admission-on overload point
+    # (flagged overload=True so curve consumers can exclude it)
+    assert sum(1 for e in points if not e.get("overload")) == 5
+    assert sum(1 for e in points if e.get("overload")) == 1

@@ -353,6 +353,28 @@ def test_ops_server_routes(clean_tracer):
         srv.close()
 
 
+def test_ops_server_progress_route():
+    """/progress serves whatever the wired callable returns (the train
+    loop wires step/epoch/ETA); without one the route stays 404 so the
+    serve-side server is unchanged."""
+    state = {"gstep": 7, "epoch": 2}
+    srv = OpsServer(port=0, progress=lambda: dict(state, eta_s=1.5)).start()
+    try:
+        code, body, _ = _get(srv.url + "/progress")
+        assert code == 200
+        assert json.loads(body) == {"gstep": 7, "epoch": 2, "eta_s": 1.5}
+    finally:
+        srv.close()
+
+    srv = OpsServer(port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + "/progress")
+        assert exc.value.code == 404
+    finally:
+        srv.close()
+
+
 def test_ops_server_close_joins_thread():
     srv = OpsServer(port=0)
     srv.start()

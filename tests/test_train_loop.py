@@ -193,6 +193,149 @@ def test_train_loop_runs_epochs_evals_and_resumes(tmp_path):
     assert int(state2.step) == 8
 
 
+# ---------------------------------------------------------------------------
+# training observatory: per-layer telemetry + the train-side ops plane
+# ---------------------------------------------------------------------------
+
+def test_train_ops_plane_health_and_progress_callables(tmp_path):
+    """The /healthz and /progress bodies come straight from the log-cadence
+    state dict — degraded reasons, ETA math, and the None-before-first-log
+    contract, without running a training step."""
+    cfg = tiny_config()
+    data = SyntheticLoaderAdapter()
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=10)
+    loop = TrainLoop(trainer, data, None, str(tmp_path / "ws"),
+                     logger=None, tb_writer=None)
+
+    assert loop._train_health() == {"status": "ok", "reasons": [],
+                                    "gstep": 0, "data_errors": 0}
+    p = loop._train_progress()
+    assert p["step_ms_avg"] is None and p["eta_s"] is None
+
+    loop._ops_state.update(gstep=10, epoch=1, epochs=4,
+                           guard_consecutive=2.0, data_errors=3,
+                           data_errors_delta=1)
+    loop._step_hist.extend([100.0, 200.0])
+    h = loop._train_health()
+    assert h["status"] == "degraded" and len(h["reasons"]) == 2
+    assert h["data_errors"] == 3
+    p = loop._train_progress()
+    assert p["total_steps"] == 40 and p["step_ms_avg"] == 150.0
+    assert p["eta_s"] == pytest.approx(30 * 150.0 / 1e3)
+
+    # and over the wire, through the same OpsServer the serve stack uses
+    import json as _json
+    import urllib.request
+    from mine_tpu.telemetry.export import OpsServer
+    srv = OpsServer(port=0, health=loop._train_health,
+                    progress=loop._train_progress).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/progress", timeout=10) as r:
+            assert _json.loads(r.read())["gstep"] == 10
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=10) as r:
+            assert _json.loads(r.read())["status"] == "degraded"
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_observatory_is_bitwise_free_and_emits_layer_events(tmp_path,
+                                                            monkeypatch):
+    """The whole observatory is numerically free: a run with per-layer
+    telemetry AND the ops plane on produces bitwise-identical params to a
+    plain run, while emitting schema-valid train.layers events with the
+    per-group stats, and serving /progress live mid-run."""
+    import json as _json
+    import socket
+    import threading
+    import urllib.request
+
+    import jax
+
+    from mine_tpu.telemetry import events as tevents
+
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+
+    def run(ws, extra, events_path=None):
+        tevents.reset()
+        tevents.configure(events_path)
+        cfg = tiny_config()
+        cfg.update({"training.log_interval": 1})
+        cfg.update(extra)
+        data = SyntheticLoaderAdapter()
+        trainer = SynthesisTrainer(cfg, steps_per_epoch=max(1, len(data)))
+        loop = TrainLoop(trainer, data, None, str(tmp_path / ws),
+                         logger=None, tb_writer=None)
+        try:
+            state = loop.run(epochs=1)
+        finally:
+            if events_path:
+                tevents.current_sink().close()
+            tevents.reset()
+        return loop, state
+
+    _, plain = run("plain", {})
+
+    with socket.socket() as s:  # a free port for training.ops_port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    captured = {}
+    done = threading.Event()
+
+    def poll():  # grab /progress while the run is live
+        url = "http://127.0.0.1:%d/progress" % port
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    captured["progress"] = _json.loads(r.read())
+                return
+            except OSError:
+                done.wait(0.05)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    ev_path = str(tmp_path / "layers.jsonl")
+    try:
+        loop, obs = run("obs", {"training.layer_stats": True,
+                                "training.ops_port": port},
+                        events_path=ev_path)
+    finally:
+        done.set()
+        poller.join(10)
+
+    # bitwise parity: observability never touches the numbers
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(plain.params),
+                              jax.tree_util.tree_leaves(obs.params)):
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+
+    assert loop._ops is None  # server closed (thread-leak tripwire backup)
+    # 1 epoch at the adapter's pair count == the final step count
+    assert captured["progress"]["total_steps"] == int(obs.step)
+
+    assert tevents.validate_file(ev_path) == []
+    layer_events = [e for e in tevents.read_events(ev_path)
+                    if e["kind"] == "train.layers"]
+    assert layer_events  # every logged step carried one
+    groups = layer_events[-1]["groups"]
+    assert "planes" in groups  # alpha distribution stats
+    for stat in ("alpha_mean", "alpha_std", "alpha_sat_lo", "alpha_sat_hi"):
+        assert stat in groups["planes"]
+    param_groups = [g for g in groups if g != "planes"]
+    assert param_groups  # encoder/decoder norm groups
+    for g in param_groups:
+        for stat in ("grad_norm", "param_norm", "update_ratio"):
+            assert stat in groups[g], (g, groups[g])
+
+    # the checkpointer's orbax executor threads are non-daemon and only
+    # wind down once the loop is cycle-collected (trainer <-> jitted-step
+    # closure) — collect here so they exit before the session-level
+    # thread-leak tripwire looks, instead of riding on GC luck
+    import gc
+    del loop
+    gc.collect()
+
+
 @pytest.mark.slow
 def test_train_epoch_grad_accum_runs(tmp_path):
     """grad_accum_steps=2 through the unchanged TrainLoop (the accumulator

@@ -6,6 +6,8 @@ the serve render engine single-device and mesh, eval encode).
 Passes (mine_tpu/analysis/passes.py):
   dtype_upcast     bf16->f32 converts inside conv-stack scopes
   dot_budget       dot_general count / FLOPs vs tools/analysis_baseline.json
+  cost_budget      compiled flops/bytes/peak-HBM vs the baseline "cost"
+                   section (AOT compile + cost/memory_analysis + roofline)
   recompile_churn  identically-shaped re-dispatch must hit the jit cache
   transfer_guard   hot paths clean under jax.transfer_guard("disallow")
   donation         donated buffers actually consumed (deleted, no warning)
@@ -76,7 +78,8 @@ def _cmd_list():
     baseline = framework.load_baseline()
     print("programs:")
     for n in programs_mod.program_names():
-        mark = " " if n in baseline.get("programs", {}) else "*"
+        mark = " " if (n in baseline.get("programs", {})
+                       and n in baseline.get("cost", {})) else "*"
         print(f"  {mark} {n}")
     print("  (* = no baseline entry yet; run --update-baseline)")
     print("passes:")
@@ -90,7 +93,8 @@ def _cmd_selftest():
     fail on it — proving the lint detects what it claims to. A selftest
     that comes back ok means the detector is blind: exit 1."""
     blind = 0
-    for p in passes_mod.default_passes({"programs": {}, "budgets": {}}):
+    for p in passes_mod.default_passes({"programs": {}, "budgets": {},
+                                        "cost": {}}):
         r = p.selftest()
         detected = not r.ok
         status = "detected" if detected else "MISSED"
@@ -108,12 +112,18 @@ def _cmd_selftest():
 def _cmd_update_baseline(path, program_names):
     baseline = framework.load_baseline(path)
     budget_pass = passes_mod.DotBudgetPass(baseline)
+    cost_pass = passes_mod.CostBudgetPass(baseline)
     progs = _select_programs(program_names)
     for prog in progs:
         measured = budget_pass.measure(prog)
         baseline["programs"][prog.name] = measured
+        cost = cost_pass.measure(prog)
+        baseline["cost"][prog.name] = cost
         det = ", ".join(f"{k}={v}" for k, v in sorted(measured.items()))
         print(f"  {prog.name:<20} {det}")
+        print(f"  {'':<20} cost: flops={cost['flops']} "
+              f"bytes={cost['bytes_accessed']} "
+              f"peak_hbm={cost['peak_hbm_bytes']}")
     # seed the cross-cutting budgets the tests consume on first write;
     # existing values are preserved (edit them deliberately, with a
     # CHANGES.md line saying why)

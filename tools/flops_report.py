@@ -1,12 +1,21 @@
 #!/usr/bin/env python
 """Static per-component cost attribution at the benchmark config (no TPU).
 
+The measurement logic moved to mine_tpu/analysis/costmodel.py
+(`attribution_report`), alongside the compiled-executable cost/memory
+model behind the `cost_budget` audit pass — same retirement precedent as
+tools/dtype_audit.py -> analysis/dtype.py. This shim keeps the CLI and its
+output byte-compatible: the human-readable per-component table on stderr,
+JSON on stdout under --json, and the peak-bound img/s line otherwise.
+
 `jax.jit(fn).lower(args).cost_analysis()` on the HLO gives flops / bytes
 for each component of the train step — the chip-free half of the time
 attribution the round-1 verdict asked for (the on-chip halves are
 tools/microbench.py and the bench profile). Flops are fusion-independent,
 so these numbers hold for the TPU executable; 'bytes accessed' of the
-UNFUSED lowering is only an upper bound and is labeled as such.
+UNFUSED lowering is only an upper bound and is labeled as such. (The
+cost_budget pass pins the POST-fusion numbers per registry program in
+tools/analysis_baseline.json.)
 
 This is also the sanity denominator for throughput claims: images/sec
 readings whose implied FLOP rate exceeds the chip's peak are measurement
@@ -17,65 +26,17 @@ Usage: python tools/flops_report.py [--json]
 Runs on CPU (forced); ~10 min of tracing on a 1-core host.
 """
 
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-V5E_BF16_PEAK_TFLOPS = 197.0
+from mine_tpu.analysis.costmodel import (  # noqa: E402,F401 (compat re-export)
+    V5E_BF16_PEAK_TFLOPS, attribution_report)
 
 
 def main():
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-
-    import bench
-    from tools import microbench
-
-    rows = {}
-
-    def add(name, fn, *args):
-        ca = jax.jit(fn).lower(*args).cost_analysis()
-        rows[name] = {
-            "tflops": round(ca.get("flops", float("nan")) / 1e12, 4),
-            "gbytes_unfused_upper_bound": round(
-                ca.get("bytes accessed", float("nan")) / 1e9, 2),
-        }
-        print("%-28s %8.4f TFLOP   %8.2f GB (unfused upper bound)"
-              % (name, rows[name]["tflops"],
-                 rows[name]["gbytes_unfused_upper_bound"]), file=sys.stderr)
-
-    # full train step at the benchmark's headline variant (shared builder:
-    # this attribution is of exactly the benchmarked program)
-    trainer, state, batch = bench.build_variant_program("xla_b4")
-    add("train_step_b4", trainer._train_step_impl, state, batch)
-
-    # isolated components at the microbench shapes (B=2, S=32, 256x384)
-    for case in ("encoder_fwd", "model_fwd", "warp_xla_fwd",
-                 "warp_xla_fwdbwd", "comp_xla_fwd", "comp_xla_fwdbwd"):
-        fn, args = microbench._case_fn(case)
-        add(case + "_b2", fn, *args)
-
-    step = rows["train_step_b4"]["tflops"]
-    out = {
-        "config": "LLFF 384x256 N=32 bf16 ResNet-50 (bench.py)",
-        "components": rows,
-        "peak_bound_images_per_sec": {
-            "v5e_bf16_peak_tflops": V5E_BF16_PEAK_TFLOPS,
-            "at_100pct_mxu": round(4 * V5E_BF16_PEAK_TFLOPS / step, 1),
-            "at_40pct_mxu": round(0.4 * 4 * V5E_BF16_PEAK_TFLOPS / step, 1),
-        },
-    }
-    # stdout JSON only under --json; the human-readable table already went
-    # to stderr line by line via add()
-    if "--json" in sys.argv:
-        print(json.dumps(out, indent=2))
-    else:
-        pb = out["peak_bound_images_per_sec"]
-        print("peak-bound img/s: %.1f @100%% MXU, %.1f @40%% (v5e %.0f TFLOP/s)"
-              % (pb["at_100pct_mxu"], pb["at_40pct_mxu"],
-                 pb["v5e_bf16_peak_tflops"]), file=sys.stderr)
+    attribution_report(sys.argv)
 
 
 if __name__ == "__main__":

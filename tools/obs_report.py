@@ -11,7 +11,10 @@ Consumes the two parseable streams the telemetry layer emits:
 
 and prints: event counts by kind, span wall-clock stats (count/mean/p50/
 p90/p99 per span path), step-time aggregates, serve bucket-compile history,
-serving-fleet cache placements/rebalances (serve.shard.* events), SLO
+serving-fleet cache placements/rebalances (serve.shard.* events), the
+resilience history (serve.admission state transitions, shard death/revive
+from serve.shard_dead / serve.shard_revive, shed/degraded/expired totals
+out of the metrics snapshot), SLO
 breaches (serve.slo_breach), the slowest request traces as per-trace
 waterfalls (trace.span events, telemetry/tracing.py), profiler trace
 windows, and the final metrics snapshot if one was emitted. Sections with
@@ -169,6 +172,46 @@ def report(events, log_lines):
             out.append("  rebalance: %s -> %s shards, moved %s of %s entries"
                        % (e.get("from_shards"), e.get("to_shards"),
                           e.get("moved"), e.get("entries")))
+
+    admissions = [e for e in events if e.get("kind") == "serve.admission"]
+    deaths = [e for e in events if e.get("kind") == "serve.shard_dead"]
+    revives = [e for e in events if e.get("kind") == "serve.shard_revive"]
+    if admissions or deaths or revives:
+        out.append("")
+        out.append("resilience (admission control + shard failover):")
+        if admissions:
+            by_state = TallyCounter(e.get("state") for e in admissions)
+            out.append("  admission transitions (%d): %s"
+                       % (len(admissions),
+                          " ".join("%s=%d" % (s, by_state[s])
+                                   for s in sorted(by_state,
+                                                   key=lambda s: (s is None,
+                                                                  s)))))
+            for e in admissions:
+                out.append("    %-8s -> %-8s score=%-8s queue=%-4s inflight=%s"
+                           % (e.get("prev"), e.get("state"), e.get("score"),
+                              e.get("queue_depth"), e.get("inflight")))
+        # shed/degraded/expired are registry counters, not events — the
+        # totals ride in the last metrics.snapshot (fleet close emits one)
+        snap_m = {}
+        for e in events:
+            if e.get("kind") == "metrics.snapshot" and e.get("metrics"):
+                snap_m = e["metrics"]
+        tallies = ["%s=%s" % (label, snap_m[key])
+                   for label, key in (("shed", "serve.admission.shed"),
+                                      ("degraded", "serve.admission.degraded"),
+                                      ("expired", "serve.batcher.expired"))
+                   if key in snap_m]
+        if tallies:
+            out.append("  load-shedding totals: " + " ".join(tallies))
+        for e in deaths:
+            out.append("  shard %s DEAD after %s failure(s), dropped %s "
+                       "cached entr(ies) (%s shards)"
+                       % (e.get("shard"), e.get("failures"),
+                          e.get("dropped"), e.get("shards")))
+        for e in revives:
+            out.append("  shard %s revived, remapped %s entr(ies) (%s shards)"
+                       % (e.get("shard"), e.get("moved"), e.get("shards")))
 
     breaches = [e for e in events if e.get("kind") == "serve.slo_breach"]
     if breaches:

@@ -76,6 +76,15 @@ if ! timeout -k 10 600 python tools/audit.py --gate \
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# every checked-in bench JSON — the historical driver wrappers and any
+# conductor-written mtpu-bench1 round — must stay parseable by
+# tools/bench_conductor.py, which diffs future sweeps against them
+if ! python tools/bench_conductor.py --check-schema; then
+    echo "BENCH_SCHEMA: a checked-in BENCH_r*.json fails" \
+         "tools/bench_conductor.py --check-schema"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # 'X' (xpass) joins the dot classes so an xpassing line can't silently
 # swallow its neighbors' dots from the count
 passed=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)

@@ -201,6 +201,15 @@ VARIANTS = {
     # achieved-QPS points; JSON ips = the knee-of-curve throughput (the
     # highest offered rate the stack still served at >= 0.9x).
     "serve_slo": (1, {}),
+    # COLD-REPLICA p99 A/B (not a train-step variant): first-request
+    # latencies on a freshly constructed engine, AOT executable store ON
+    # (boots by deserializing compiled artifacts — serve/aot.py) vs OFF
+    # (pays live jit per pose bucket inline), plus the fully-warm p99 the
+    # ROADMAP success metric compares against. JSON ips = the cold-p99
+    # store-off / store-on ratio (> 1 means the store wins); the persistent
+    # compile cache is disabled inside this variant's subprocess so the
+    # off arm can't cheat by reading this process's own compiles back.
+    "serve_coldstart": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -925,6 +934,113 @@ def _measure_serve_slo(name, steps=MEASURE_STEPS, keep_run=False):
     return knee, None, (run if keep_run else None), 1
 
 
+def _measure_serve_coldstart(name, steps=MEASURE_STEPS, keep_run=False):
+    """Cold-replica p99, AOT store on vs off (the serve_coldstart variant).
+
+    Builds the artifact store once (one engine pays the compiles and
+    writes back), then measures per-request latency of the FIRST n
+    requests on a fresh engine two ways: store ON (warmup deserializes
+    executables, zero live compiles) and store OFF (every pose bucket's
+    first request pays jit inline). Requests cycle pose counts 1..bucket
+    so every bucket's cold cost lands inside the measured window, matching
+    the ROADMAP metric "p99 of the first 100 requests on a cold replica
+    ~= warm p99". One parseable stderr line; JSON ips = the
+    cold-p99-off / cold-p99-on ratio (> 1: the store wins)."""
+    import tempfile
+
+    import numpy as np
+    import jax
+
+    from mine_tpu.kernels import on_tpu_backend
+    from mine_tpu.serve import AOTStore, MPICache, RenderEngine
+
+    # the off arm must pay REAL compiles: the persistent compile cache
+    # (configure_compile_cache in the parent) would hand it this very
+    # process's builder compiles from disk. Per-variant subprocess
+    # isolation makes this config flip safe.
+    jax.config.update("jax_enable_compilation_cache", False)
+
+    trainer, state, batch = build_variant_program(name)
+    max_bucket = 8
+    builder, image_id, _, _, _ = _serve_bench_engine(
+        trainer, state, batch, max_bucket=max_bucket)
+    entry = builder.cache.get(image_id)
+    cfg = trainer.cfg
+    store_dir = tempfile.mkdtemp(prefix="mtpu_aot_bench_")
+
+    def fresh(store):
+        engine = RenderEngine(
+            use_alpha=cfg.use_alpha,
+            is_bg_depth_inf=cfg.is_bg_depth_inf,
+            backend="pallas" if on_tpu_backend() else "xla",
+            warp_band=cfg.warp_band,
+            warp_sep_tol=cfg.warp_sep_tol,
+            max_bucket=max_bucket,
+            cache=MPICache(quant="bf16"),
+            aot_store=store)
+        engine.cache.adopt(image_id, entry)
+        return engine
+
+    # build once: this engine pays every bucket's compile and writes back
+    fresh(AOTStore(store_dir)).warmup(image_id)
+
+    n_req = 16 if SMOKE else 100
+    poses = _serve_bench_poses(max_bucket)
+
+    def first_requests(engine, warm_from_store):
+        t_boot = time.perf_counter()
+        if warm_from_store:
+            engine.warmup(image_id)
+        boot_ms = (time.perf_counter() - t_boot) * 1e3
+        lat = []
+        for i in range(n_req):
+            k = (i % max_bucket) + 1  # cycle every pose bucket cold
+            t0 = time.perf_counter()
+            engine.render(image_id, poses[:k])
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return boot_ms, np.asarray(lat)
+
+    eng_on = fresh(AOTStore(store_dir))
+    boot_on, lat_on = first_requests(eng_on, warm_from_store=True)
+    eng_off = fresh(None)
+    _, lat_off = first_requests(eng_off, warm_from_store=False)
+    # the on-engine is now fully warm: its second window is the baseline
+    # the ROADMAP metric compares the cold windows against
+    _, lat_warm = first_requests(eng_on, warm_from_store=False)
+
+    p99_on = float(np.percentile(lat_on, 99))
+    p99_off = float(np.percentile(lat_off, 99))
+    p99_warm = float(np.percentile(lat_warm, 99))
+    print("  serve_coldstart: cold_p99_on=%.1fms cold_p99_off=%.1fms "
+          "warm_p99=%.1fms boot_on=%.0fms loads=%d compiles_on=%d "
+          "compiles_off=%d (p99 of first %d requests per arm)"
+          % (p99_on, p99_off, p99_warm, boot_on, eng_on.bucket_loads,
+             eng_on.bucket_compiles, eng_off.bucket_compiles, n_req),
+          file=sys.stderr)
+    speedup = p99_off / max(p99_on, 1e-9)
+    print("  serve_coldstart: cold-replica p99 %.2fx better with store "
+          "(cold/warm ratio on=%.2f off=%.2f)"
+          % (speedup, p99_on / max(p99_warm, 1e-9),
+             p99_off / max(p99_warm, 1e-9)), file=sys.stderr)
+    from mine_tpu import telemetry
+    telemetry.emit("serve.coldstart_point",
+                   cold_p99_on_ms=round(p99_on, 3),
+                   cold_p99_off_ms=round(p99_off, 3),
+                   warm_p99_ms=round(p99_warm, 3),
+                   boot_on_ms=round(boot_on, 3),
+                   loads=eng_on.bucket_loads,
+                   compiles_off=eng_off.bucket_compiles,
+                   n_requests=n_req)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng_on.render(image_id, poses)
+        return time.perf_counter() - t0
+
+    return speedup, None, (run if keep_run else None), 1
+
+
 def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
     """training.ssim_precision A/B (the ssim_precision_ab variants).
 
@@ -967,6 +1083,9 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
         return _measure_serve_amortize(name, steps=steps, keep_run=keep_run)
     if name.startswith("serve_slo"):
         return _measure_serve_slo(name, steps=steps, keep_run=keep_run)
+    if name.startswith("serve_coldstart"):
+        return _measure_serve_coldstart(name, steps=steps,
+                                        keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):

@@ -10,6 +10,9 @@
   donation          donated buffers actually consumed (deleted, no warning)
   concurrency       global lock-acquisition order + thread-leak check over a
                     live threaded serve workload (global pass)
+  aot_staleness     serving AOT executable store artifacts current for this
+                    jax version / backend / topology (global pass; skips
+                    when no store is configured)
 
 Every pass ships `selftest()`: it seeds the violation the pass exists to
 catch (an unjustified conv-scope upcast, a budget mismatch, a weak-type
@@ -471,12 +474,83 @@ class ConcurrencyPass(AuditPass):
                             details="monitor failed to record inversion")
 
 
+# --------------------------------------------------------- AOT staleness
+
+class AOTStalenessPass(AuditPass):
+    """Audits the serving AOT executable store (serve/aot.py): every
+    artifact's environment fingerprint must match the CURRENT jax/jaxlib
+    version, backend, and device topology, and every sidecar must be
+    readable and consistent with its content address. A stale artifact is
+    harmless at runtime (content addressing makes it a miss, never a wrong
+    load) but it means a replica believed warm will silently pay live
+    compiles — exactly the regression this store exists to kill — so the
+    gate fails until `tools/aot_warmstore.py --gc` (or a rebuild) clears
+    it. Skips when no store is configured (MINE_TPU_AOT_STORE)."""
+
+    name = "aot_staleness"
+    scope = "global"
+
+    def __init__(self, root: Optional[str] = None):
+        # explicit root for tools/aot_warmstore.py --check; the audit gate
+        # reads the env var so CI without a store skips cleanly
+        self.root = root
+
+    def run_global(self) -> PassResult:
+        import os
+        from mine_tpu.serve import aot as _aot
+        root = self.root or os.environ.get("MINE_TPU_AOT_STORE", "")
+        if not root or not os.path.isdir(root):
+            return self._skip(
+                "-", "no AOT store configured (set MINE_TPU_AOT_STORE or "
+                     "serve.aot_store_dir to audit one)")
+        store = _aot.AOTStore(root)
+        entries = store.entries()
+        stale = store.stale_entries()
+        if stale:
+            corrupt = [e for e in stale if e["corrupt"]]
+            fp = _aot.env_fingerprint()
+            return self._result(
+                "-", ok=False,
+                details=f"{len(stale)}/{len(entries)} artifacts stale for "
+                        f"current environment (jax {fp['jax']}, "
+                        f"{fp['backend']}, {fp['devices']}; "
+                        f"{len(corrupt)} corrupt) — rebuild or run "
+                        f"tools/aot_warmstore.py --gc",
+                stale=[e["digest"][:12] for e in stale[:8]],
+                fingerprint=fp)
+        return self._result(
+            "-", ok=True,
+            details=f"{len(entries)} artifacts current for jax "
+                    f"{_aot.env_fingerprint()['jax']}")
+
+    def selftest(self) -> PassResult:
+        # seeded violation: an artifact whose fingerprint claims another
+        # jax version — the staleness check MUST flag it
+        import json
+        import tempfile
+        from mine_tpu.serve import aot as _aot
+        with tempfile.TemporaryDirectory() as root:
+            store = _aot.AOTStore(root)
+            key = {"program": "selftest",
+                   "fingerprint": dict(_aot.env_fingerprint(),
+                                       jax="0.0.0-selftest")}
+            digest = _aot.key_digest(key)
+            art, side = store._paths(digest)
+            with open(art, "wb") as f:
+                f.write(b"not a real executable")
+            with open(side, "w", encoding="utf-8") as f:
+                json.dump({"key": key, "nbytes": 0}, f)
+            check = AOTStalenessPass(root=root)
+            return check.run_global()
+
+
 # ---------------------------------------------------------------- suites
 
 def default_passes(baseline: Dict) -> List[AuditPass]:
     return [DtypeUpcastPass(), DotBudgetPass(baseline),
             CostBudgetPass(baseline), RecompileChurnPass(),
-            TransferGuardPass(), DonationPass(), ConcurrencyPass()]
+            TransferGuardPass(), DonationPass(), ConcurrencyPass(),
+            AOTStalenessPass()]
 
 
 def pass_by_name(name: str, baseline: Optional[Dict] = None) -> AuditPass:

@@ -273,6 +273,15 @@ class ServeConfig:
     admission_inflight_high: int = 256
     admission_shed_factor: float = 2.0
     admission_hysteresis: float = 0.7
+    # serve.aot_store_dir: directory of serialized compiled render
+    # executables (serve/aot.py) — warmup loads instead of tracing, live
+    # compiles write back; "" (default) disables the store entirely
+    aot_store_dir: str = ""
+    # serve.encoder_quant: off | int8 — int8 stores the sync-encode
+    # encoder weights symmetric per-output-channel with dequant fused into
+    # the jitted encode (serve/encoder.py); off is byte-identical to the
+    # pre-quantization path
+    encoder_quant: str = "off"
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -305,6 +314,10 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
             g("serve.admission.inflight_high", 256) or 0),
         admission_shed_factor=float(g("serve.admission.shed_factor", 2.0)),
         admission_hysteresis=float(g("serve.admission.hysteresis", 0.7)),
+        aot_store_dir=str(g("serve.aot_store_dir", "") or ""),
+        # YAML 1.1 reads a bare `off` as boolean False — accept it
+        encoder_quant=("off" if g("serve.encoder_quant", "off") is False
+                       else str(g("serve.encoder_quant", "off"))),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -378,6 +391,11 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.admission.hysteresis must be in (0, 1], "
             f"got {out.admission_hysteresis}")
+    from mine_tpu.serve.encoder import ENCODER_QUANT_MODES
+    if out.encoder_quant not in ENCODER_QUANT_MODES:
+        raise ValueError(
+            f"serve.encoder_quant must be one of "
+            f"{'|'.join(ENCODER_QUANT_MODES)}, got {out.encoder_quant!r}")
     return out
 
 

@@ -120,7 +120,8 @@ class VideoGenerator:
                  seed: int = 0,
                  backend: Optional[str] = None,
                  engine: Optional[RenderEngine] = None,
-                 cache_quant: str = "float32"):
+                 cache_quant: str = "float32",
+                 encoder_quant: str = "off"):
         self.cfg = mpi_config_from_dict(config)
         validate_model_shapes(self.cfg)
         self.config = config
@@ -146,8 +147,18 @@ class VideoGenerator:
 
         # one network pass (reference infer_network :112-153)
         disparity = sample_disparity(jax.random.PRNGKey(seed), 1, self.cfg)
-        variables = {"params": params, "batch_stats": batch_stats}
-        mpi = model.apply(variables, self.img, disparity, train=False)[0]
+        if encoder_quant == "off":
+            variables = {"params": params, "batch_stats": batch_stats}
+            mpi = model.apply(variables, self.img, disparity, train=False)[0]
+        else:
+            # serve.encoder_quant=int8: weights stored per-channel int8 with
+            # the widening dequant fused into the jitted encode
+            # (mine_tpu/serve/encoder.py); a pre-quantized params tree
+            # (serve_cli quantizes once for all images) passes through
+            from mine_tpu.serve.encoder import make_encode_fn
+            encode = make_encode_fn(model, params, batch_stats,
+                                    encoder_quant=encoder_quant)
+            mpi = encode(self.img, disparity)
         self.disparity = disparity
 
         grid = geometry.cached_pixel_grid(H, W)

@@ -6,6 +6,9 @@ asymmetry (README "Serving" / "Sharded serving"):
 
   cache.py     MPICache — LRU of quantized MPI planes under a byte budget
   engine.py    RenderEngine — shape-bucketed jitted render-only program
+  aot.py       AOTStore — serialized compiled-executable store for
+               zero-warmup replica boot
+  encoder.py   int8 encoder-weight quantization for the sync-encode path
   batcher.py   MicroBatcher / ContinuousBatcher — request coalescing
   admission.py AdmissionController — tiered load shedding / degradation
   shardmap.py  serving mesh ("batch","model") + MeshRenderEngine
@@ -19,6 +22,9 @@ config.ServeConfig).
 from mine_tpu.serve.admission import (TIER_BEST_EFFORT, TIER_CRITICAL,
                                       TIER_STANDARD, AdmissionController,
                                       DeadlineExceeded, RequestShed)
+from mine_tpu.serve.aot import AOTStore, env_fingerprint
+from mine_tpu.serve.encoder import (dequantize_weights, make_encode_fn,
+                                    quantize_weights_int8)
 from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   dequantize_planes, image_id_for,
@@ -30,11 +36,13 @@ from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
                                      render_shardings)
 
 __all__ = [
-    "AdmissionController", "ContinuousBatcher", "DeadlineExceeded",
-    "MPICache", "MPIEntry", "MeshRenderEngine", "MicroBatcher",
-    "PyramidCache", "RenderEngine", "RequestShed", "SERVE_BATCH_AXIS",
-    "SERVE_MODEL_AXIS", "ServeFleet", "ShardedPlaneCache",
-    "TIER_BEST_EFFORT", "TIER_CRITICAL", "TIER_STANDARD",
-    "dequantize_planes", "image_id_for", "make_serve_mesh", "pow2_bucket",
-    "quantize_planes", "render_shardings", "shard_for_key",
+    "AOTStore", "AdmissionController", "ContinuousBatcher",
+    "DeadlineExceeded", "MPICache", "MPIEntry", "MeshRenderEngine",
+    "MicroBatcher", "PyramidCache", "RenderEngine", "RequestShed",
+    "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS", "ServeFleet",
+    "ShardedPlaneCache", "TIER_BEST_EFFORT", "TIER_CRITICAL",
+    "TIER_STANDARD", "dequantize_planes", "dequantize_weights",
+    "env_fingerprint", "image_id_for", "make_encode_fn", "make_serve_mesh",
+    "pow2_bucket", "quantize_planes", "quantize_weights_int8",
+    "render_shardings", "shard_for_key",
 ]

@@ -1,0 +1,252 @@
+"""AOT-compiled executable store: zero-warmup boot for serving replicas.
+
+Every fleet scale-up, failover revival, or restart pays jit warmup per
+(entries bucket, pose bucket, warp_impl, quant dtype, mesh shape) render
+program — and the compile set is BOUNDED (engine.py docstring), so it is
+enumerable offline. This module persists the compiled executables
+themselves:
+
+    build (tools/aot_warmstore.py, or any engine's live write-back)
+      -> ship (the artifact directory is plain files; rsync/bake it)
+      -> boot (`RenderEngine.warmup` loads executables instead of tracing)
+      -> GC   (`AOTStore.gc` drops artifacts whose environment fingerprint
+               no longer matches; `tools/audit.py`'s aot_staleness pass
+               gates on it)
+
+Artifacts are content-addressed: sha256 of the canonical-JSON *program key*
+(bucket shapes + engine statics + mesh shape + environment fingerprint)
+names the file, so a key change — different jax version, backend, topology,
+or render configuration — can never alias a stale executable. Each artifact
+is a pickle of `jax.experimental.serialize_executable.serialize` output
+plus the key, written atomically, with a JSON sidecar carrying the key
+alone so `--check` / GC / reporting never unpickle executable payloads.
+
+The store is purely an ACCELERATOR, never a correctness dependency: every
+load failure (missing, corrupt, key mismatch, deserialization error)
+returns None and the engine falls back to live jit — then writes the fresh
+executable back so the next replica boots warm.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+from mine_tpu import telemetry
+
+_log = logging.getLogger(__name__)
+
+# artifact / sidecar extensions: <digest>.aotx holds the pickled payload,
+# <digest>.json holds the key alone (never unpickled for checks or GC)
+ARTIFACT_EXT = ".aotx"
+SIDECAR_EXT = ".json"
+
+# bumped when the artifact layout changes; part of every program key so a
+# layout change invalidates (misses, not crashes) every old artifact
+STORE_SCHEMA = "mtpu-aot1"
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """The environment a compiled executable is only valid in: jax/jaxlib
+    versions, backend platform, and device topology. Part of every program
+    key, so artifacts from another environment hash to different names and
+    simply miss (and `gc` can sweep them by comparing this dict)."""
+    import jax
+    import jaxlib
+    devices = jax.devices()
+    return {
+        "schema": STORE_SCHEMA,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.version.__version__,
+        "backend": jax.default_backend(),
+        "devices": f"{len(devices)}x{devices[0].device_kind}",
+        "processes": jax.process_count(),
+    }
+
+
+def key_digest(key: Dict[str, Any]) -> str:
+    """Content address: sha256 over the canonical (sorted, compact) JSON of
+    the program key."""
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class AOTStore:
+    """Content-addressed directory of serialized compiled executables.
+
+    `load` returns a ready-to-call `Compiled` (invoked with the program's
+    DYNAMIC arguments only — static argnames are baked in) or None on any
+    miss or failure; `save` serializes and writes atomically. Counters
+    (`hits`/`misses`/`load_errors`/`saves`/`save_errors`) mirror into the
+    telemetry registry under `serve.aot.*`.
+    """
+
+    def __init__(self, root: str):
+        if not root:
+            raise ValueError("AOTStore needs a directory path")
+        self.root = str(root)
+        self.hits = 0
+        self.misses = 0
+        self.load_errors = 0
+        self.saves = 0
+        self.save_errors = 0
+        self._warned = set()
+
+    # ---------------- paths ----------------
+
+    def _paths(self, digest: str) -> Tuple[str, str]:
+        return (os.path.join(self.root, digest + ARTIFACT_EXT),
+                os.path.join(self.root, digest + SIDECAR_EXT))
+
+    def _warn_once(self, slot: str, msg: str) -> None:
+        if slot not in self._warned:
+            self._warned.add(slot)
+            _log.warning("%s", msg)
+
+    # ---------------- load / save ----------------
+
+    def load(self, key: Dict[str, Any]):
+        """Deserialize the executable for `key`, or None (miss or any
+        failure — the caller's live-jit fallback is the contract)."""
+        digest = key_digest(key)
+        art, _ = self._paths(digest)
+        if not os.path.exists(art):
+            self.misses += 1
+            telemetry.counter("serve.aot.misses").inc()
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+            with open(art, "rb") as f:
+                blob = pickle.load(f)
+            if blob.get("key") != key:
+                # digest collision or a hand-edited artifact: treat as a
+                # corrupt entry, never hand back a mismatched executable
+                raise ValueError("artifact key does not match request key")
+            exe = se.deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"])
+        except Exception as e:  # noqa: BLE001 - any failure means "miss"
+            self.load_errors += 1
+            telemetry.counter("serve.aot.load_errors").inc()
+            self._warn_once(
+                "load:" + digest,
+                f"AOT store load failed for {digest[:12]}… ({e!r}); "
+                f"falling back to live jit")
+            return None
+        self.hits += 1
+        telemetry.counter("serve.aot.hits").inc()
+        return exe
+
+    def save(self, key: Dict[str, Any], compiled) -> bool:
+        """Serialize `compiled` under `key` (artifact + sidecar, each via
+        atomic tmp+rename). Returns False on any failure — a broken store
+        must never break serving."""
+        try:
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = pickle.dumps({"key": key, "payload": payload,
+                                 "in_tree": in_tree, "out_tree": out_tree})
+            os.makedirs(self.root, exist_ok=True)
+            digest = key_digest(key)
+            art, side = self._paths(digest)
+            self._atomic_write(art, blob)
+            meta = json.dumps({"key": key, "nbytes": len(blob)},
+                              sort_keys=True, indent=1)
+            self._atomic_write(side, meta.encode("utf-8"))
+        except Exception as e:  # noqa: BLE001
+            self.save_errors += 1
+            telemetry.counter("serve.aot.save_errors").inc()
+            self._warn_once("save", f"AOT store save failed ({e!r}); "
+                                    f"serving continues without write-back")
+            return False
+        self.saves += 1
+        telemetry.counter("serve.aot.saves").inc()
+        return True
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def contains(self, key: Dict[str, Any]) -> bool:
+        return os.path.exists(self._paths(key_digest(key))[0])
+
+    # ---------------- inventory / GC ----------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """[{digest, key, nbytes, corrupt}] from sidecars alone (artifacts
+        without a readable sidecar are listed as corrupt — check/GC treat
+        them as stale)."""
+        out: List[Dict[str, Any]] = []
+        if not os.path.isdir(self.root):
+            return out
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(ARTIFACT_EXT):
+                continue
+            digest = name[:-len(ARTIFACT_EXT)]
+            art, side = self._paths(digest)
+            rec = {"digest": digest, "key": None, "corrupt": False,
+                   "nbytes": os.path.getsize(art)}
+            try:
+                with open(side, "r", encoding="utf-8") as f:
+                    meta = json.load(f)
+                rec["key"] = meta["key"]
+                if key_digest(meta["key"]) != digest:
+                    rec["corrupt"] = True
+            except Exception:  # noqa: BLE001
+                rec["corrupt"] = True
+            out.append(rec)
+        return out
+
+    def stale_entries(self,
+                      fingerprint: Optional[Dict[str, Any]] = None
+                      ) -> List[Dict[str, Any]]:
+        """Entries whose environment fingerprint differs from the current
+        one (plus corrupt entries): exactly the set `gc` removes and the
+        audit pass fails on."""
+        if fingerprint is None:
+            fingerprint = env_fingerprint()
+        stale = []
+        for rec in self.entries():
+            if rec["corrupt"] or \
+                    (rec["key"] or {}).get("fingerprint") != fingerprint:
+                stale.append(rec)
+        return stale
+
+    def gc(self, dry_run: bool = False) -> List[str]:
+        """Remove stale/corrupt artifacts (and their sidecars); returns the
+        removed digests."""
+        removed = []
+        for rec in self.stale_entries():
+            art, side = self._paths(rec["digest"])
+            if not dry_run:
+                for p in (art, side):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            removed.append(rec["digest"])
+        return removed
+
+    def stats(self) -> Dict[str, Any]:
+        ents = self.entries()
+        return {
+            "root": self.root,
+            "artifacts": len(ents),
+            "bytes": sum(e["nbytes"] for e in ents),
+            "hits": self.hits, "misses": self.misses,
+            "load_errors": self.load_errors,
+            "saves": self.saves, "save_errors": self.save_errors,
+        }

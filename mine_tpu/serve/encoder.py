@@ -1,0 +1,127 @@
+"""int8 *weight* quantization for the serve-side encoder.
+
+A cold replica's cache misses pay a synchronous encode (engine._entry), and
+the encoder's weight tensors dominate both the checkpoint bytes a booting
+replica pulls and the HBM reads of that encode. This module stores the
+encoder params as symmetric per-output-channel int8 — the exact scheme
+`serve/cache.py` applies to MPI planes (amax/127 scale, zero-point-free,
+all-zero guard) lifted from [S,C,1,1] plane scales to per-channel weight
+scales — with the widening dequant FUSED into the jitted encode, so int8
+is what crosses HBM and f32 is what the matmuls see.
+
+Only float weight tensors with ndim >= 2 (Dense/Conv kernels) quantize;
+biases, scalars, and batch-norm vectors stay f32 — they are tiny and their
+precision is load-bearing. Everything is a knob: `serve.encoder_quant`
+defaults to "off", which leaves the params tree untouched byte-for-byte
+(pinned by tests/test_serve_aot.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+ENCODER_QUANT_MODES = ("off", "int8")
+
+# a quantized leaf is a dict with exactly these keys, so tree traversal can
+# tell it from an ordinary params subtree without any side table
+_QKEYS = frozenset(("q", "scale"))
+
+
+def _is_qleaf(node: Any) -> bool:
+    return isinstance(node, Mapping) and frozenset(node.keys()) == _QKEYS
+
+
+def _quantizable(x: Any) -> bool:
+    return (hasattr(x, "ndim") and x.ndim >= 2
+            and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating))
+
+
+def _quantize_leaf(w) -> Mapping[str, jnp.ndarray]:
+    """f32 [..., out] kernel -> {"q": int8, "scale": f32 per-out-channel}.
+    Mirrors cache.quantize_planes: symmetric, amax/127, all-zero guard."""
+    w = jnp.asarray(w, jnp.float32)
+    axes = tuple(range(w.ndim - 1))  # all but the output-feature axis
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def is_quantized(params: Any) -> bool:
+    """True if the tree contains at least one quantized leaf."""
+    found = []
+
+    def walk(node):
+        if _is_qleaf(node):
+            found.append(True)
+        elif isinstance(node, Mapping):
+            for v in node.values():
+                walk(v)
+
+    walk(params)
+    return bool(found)
+
+
+def quantize_weights_int8(params: Any) -> Any:
+    """Quantize every >=2-D float leaf of a params tree to int8 + scales;
+    other leaves pass through unchanged. Idempotent (already-quantized
+    leaves are kept as-is) so callers can pre-quantize once and reuse."""
+    if _is_qleaf(params):
+        return params
+    if isinstance(params, Mapping):
+        return {k: quantize_weights_int8(v) for k, v in params.items()}
+    if _quantizable(params):
+        return _quantize_leaf(params)
+    return params
+
+
+def dequantize_weights(params: Any) -> Any:
+    """Inverse of quantize_weights_int8; jit-traceable (the tree structure
+    is static, the dequant is a widening cast * scale — the same fused
+    pattern as engine._render_impl's plane dequant)."""
+    if _is_qleaf(params):
+        return params["q"].astype(jnp.float32) * params["scale"]
+    if isinstance(params, Mapping):
+        return {k: dequantize_weights(v) for k, v in params.items()}
+    return params
+
+
+def make_encode_fn(model, params, batch_stats,
+                   encoder_quant: str = "off"):
+    """Jitted image+disparity -> MPI encode with optional int8 weights.
+
+    `model.apply({"params": p, "batch_stats": bs}, img, disp, train=False)`
+    is the contract (infer/video.py's encode line). Params and batch stats
+    are passed as ARGUMENTS of the jitted function — not closed over — so
+    they stay device buffers instead of getting baked into the program as
+    constants. With `encoder_quant="int8"` the stored tree is quantized
+    once here (idempotent for pre-quantized trees) and dequantized INSIDE
+    the jit, so int8 is the form that crosses HBM.
+
+    Returns `encode(img, disparity) -> mpi`; the stored (possibly
+    quantized) tree is exposed as `encode.params` for introspection.
+    """
+    if encoder_quant not in ENCODER_QUANT_MODES:
+        raise ValueError(
+            f"serve.encoder_quant must be one of {ENCODER_QUANT_MODES}, "
+            f"got {encoder_quant!r}")
+    quantized = encoder_quant == "int8"
+    stored = quantize_weights_int8(params) if quantized else params
+
+    def _encode(p, bs, img, disparity):
+        if quantized:
+            p = dequantize_weights(p)
+        return model.apply({"params": p, "batch_stats": bs},
+                           img, disparity, train=False)[0]
+
+    jitted = jax.jit(_encode)
+
+    def encode(img, disparity):
+        return jitted(stored, batch_stats, img, disparity)
+
+    encode.params = stored
+    encode.quantized = quantized
+    return encode

@@ -102,7 +102,8 @@ class RenderEngine:
                  cache: Optional[MPICache] = None,
                  encode_fn: Optional[Callable] = None,
                  encode_retries: int = 0,
-                 encode_backoff_ms: float = 10.0):
+                 encode_backoff_ms: float = 10.0,
+                 aot_store=None):
         if max_bucket < 1 or (max_bucket & (max_bucket - 1)) != 0:
             raise ValueError(
                 f"serve.max_bucket must be a power of two >= 1, "
@@ -126,8 +127,16 @@ class RenderEngine:
         # `encode_backoff_ms`; 0 retries = fail on the first error
         self.encode_retries = int(encode_retries)
         self.encode_backoff_ms = float(encode_backoff_ms)
+        # optional serve/aot.py AOTStore: first dispatch of a bucket tries
+        # a store load before tracing, and live compiles write back. None
+        # (the default) keeps the dispatch path byte-identical to before.
+        self.aot_store = aot_store
         self.device_calls = 0
         self.sync_encodes = 0
+        # cold-bucket accounting, split by how the executable arrived:
+        # a live jit trace+compile vs a deserialized store artifact
+        self.bucket_compiles = 0
+        self.bucket_loads = 0
         # pose buckets never drop below this (the mesh subclass raises it
         # to its "batch" axis size so buckets split evenly across devices)
         self._min_pose_bucket = 1
@@ -135,6 +144,10 @@ class RenderEngine:
         # first-seen key means jit traces + compiles a new executable —
         # the compile-set growth the pow2 bucketing is meant to bound
         self._seen_buckets = set()
+        # aval-key -> Compiled executable (store-loaded or live-lowered);
+        # only populated when an AOTStore is attached — without one every
+        # dispatch goes through the plain jit below, exactly as before
+        self._aot_execs = {}
         self._render = jax.jit(self._render_impl,
                                static_argnames=("warp_impl",))
 
@@ -258,6 +271,96 @@ class RenderEngine:
         topology rendered the request."""
         return {}
 
+    # ---------------- AOT executable store (serve/aot.py) ----------------
+
+    def _mesh_desc(self) -> str:
+        """Mesh-shape component of the AOT program key; the mesh subclass
+        overrides so e.g. a 2x1 fleet never loads a 1x1 executable."""
+        return "1x1"
+
+    def _aval_key(self, Rb: int, Pb: int, warp_impl: str, dtype: str,
+                  S: int, H: int, W: int, has_scales: bool) -> tuple:
+        """The in-process executable-cache key: everything that changes the
+        program's input avals. Derivable both from staged arrays (dispatch)
+        and from entry metadata + bucket sizes (warmup-from-store)."""
+        return (Rb, Pb, warp_impl, dtype, S, H, W, has_scales)
+
+    def _program_key(self, Rb: int, Pb: int, warp_impl: str, dtype: str,
+                     S: int, H: int, W: int, has_scales: bool) -> dict:
+        """The store's content-address input: the aval key plus every
+        engine static baked into the traced program, the mesh shape, and
+        the environment fingerprint (serve/aot.py)."""
+        from mine_tpu.serve import aot as _aot
+        return {
+            "program": "serve_render",
+            "entries_bucket": Rb, "poses_bucket": Pb,
+            "warp_impl": warp_impl, "dtype": dtype,
+            "planes": [S, H, W], "scaled": has_scales,
+            "mesh": self._mesh_desc(),
+            "engine": {
+                "use_alpha": self.use_alpha,
+                "is_bg_depth_inf": self.is_bg_depth_inf,
+                "backend": self.backend,
+                "warp_band": self.warp_band,
+                "warp_dtype": self.warp_dtype,
+                "warp_sep_tol": self.warp_sep_tol,
+            },
+            "fingerprint": _aot.env_fingerprint(),
+        }
+
+    def _dispatch(self, args, warp_impl: str):
+        """Run the render program on staged args. Without a store this IS
+        `self._render` (plain jit). With one, resolve a Compiled executable
+        per aval key — store load, else a live `lower().compile()` written
+        back — and invoke it with the DYNAMIC args only (`warp_impl` is
+        baked into the compiled program). Returns (rgb, depth, source)
+        where source is "jit" | "load" | "compile"."""
+        if self.aot_store is None:
+            rgb, depth = self._render(*args, warp_impl)
+            return rgb, depth, "jit"
+        planes, scales, _, _, _, _, poses = args
+        key = self._aval_key(planes.shape[0], poses.shape[0], warp_impl,
+                             str(planes.dtype), planes.shape[1],
+                             planes.shape[-2], planes.shape[-1],
+                             scales is not None)
+        exe = self._aot_execs.get(key)
+        source = "warm"
+        if exe is None:
+            pkey = self._program_key(*key)
+            exe = self.aot_store.load(pkey)
+            source = "load"
+            if exe is None:
+                # miss or failed deserialize: live compile, write back so
+                # the NEXT replica boots warm (the store is an accelerator,
+                # never a correctness dependency)
+                exe = self._render.lower(*args,
+                                         warp_impl=warp_impl).compile()
+                self.aot_store.save(pkey, exe)
+                source = "compile"
+            self._aot_execs[key] = exe
+        rgb, depth = exe(*args)
+        return rgb, depth, source
+
+    def _register_store_hit(self, bucket, key) -> bool:
+        """Warmup hook: try loading `bucket`'s executable from the store;
+        on a hit register it (no trace, no render) and account the
+        cold-bucket event as a LOAD. Returns hit."""
+        pkey = self._program_key(*key)
+        t0 = time.perf_counter()
+        exe = self.aot_store.load(pkey)
+        if exe is None:
+            return False
+        self._aot_execs[key] = exe
+        self._seen_buckets.add(bucket)
+        load_ms = (time.perf_counter() - t0) * 1e3
+        self.bucket_loads += 1
+        telemetry.counter("serve.bucket_loads").inc()
+        telemetry.emit("serve.bucket_compile", entries_bucket=bucket[0],
+                       poses_bucket=bucket[1], warp_impl=bucket[2],
+                       dtype=bucket[3], compile_ms=round(load_ms, 3),
+                       store_hit=True)
+        return True
+
     def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
               poses: np.ndarray, warp_impl: Optional[str],
               traces: Optional[Sequence] = None):
@@ -300,7 +403,7 @@ class RenderEngine:
                            jnp.asarray(poses, jnp.float32))
         t_dispatch = time.perf_counter()
         faults.on_render()  # chaos seam: injected slow device (no-op unplanned)
-        rgb, depth = self._render(*args, warp_impl)
+        rgb, depth, source = self._dispatch(args, warp_impl)
         self.device_calls += 1
         with telemetry.host_readback("serve.render_fetch"):  # device sync
             out = np.asarray(rgb[:P]), np.asarray(depth[:P])
@@ -309,16 +412,24 @@ class RenderEngine:
         bucket = (Rb, Pb, warp_impl, str(planes.dtype))
         compiled = bucket not in self._seen_buckets
         if compiled:
-            # first dispatch of this (shape-bucket, impl, dtype) key: jit
-            # traced + compiled a new executable, so this call's time is
-            # compile-dominated — recorded as a compile event, NOT into
-            # the warm-latency histogram it would wreck
+            # first dispatch of this (shape-bucket, impl, dtype) key: the
+            # executable arrived either via a live jit trace+compile or a
+            # store load (serve/aot.py), so this call's time is cold-path
+            # dominated — recorded as a cold-bucket event, NOT into the
+            # warm-latency histogram it would wreck
             self._seen_buckets.add(bucket)
-            telemetry.counter("serve.bucket_compiles").inc()
+            store_hit = source == "load"
+            if store_hit:
+                self.bucket_loads += 1
+                telemetry.counter("serve.bucket_loads").inc()
+            else:
+                self.bucket_compiles += 1
+                telemetry.counter("serve.bucket_compiles").inc()
             telemetry.emit("serve.bucket_compile", entries_bucket=Rb,
                            poses_bucket=Pb, warp_impl=warp_impl,
                            dtype=str(planes.dtype),
-                           compile_ms=round(elapsed_ms, 3))
+                           compile_ms=round(elapsed_ms, 3),
+                           store_hit=store_hit)
         else:
             telemetry.histogram("serve.render_call_ms").record(elapsed_ms)
         if traces:
@@ -411,10 +522,23 @@ class RenderEngine:
 
     def warmup(self, image_id: str,
                pose_counts: Optional[Sequence[int]] = None,
-               warp_impl: Optional[str] = None) -> None:
-        """Pre-trace the bucketed programs against a cached entry, through
-        JAX's persistent compile cache (utils.configure_compile_cache) so a
-        restarted server skips the compiles entirely."""
+               warp_impl: Optional[str] = None,
+               entries_counts: Sequence[int] = (1,)) -> None:
+        """Make the bucketed programs hot against a cached entry. Without
+        an AOT store this pre-traces through JAX's persistent compile cache
+        (utils.configure_compile_cache), exactly as before. With one
+        (serve/aot.py), each bucket first tries a store load — registering
+        the executable with zero program compiles — and only a miss falls
+        back to the live render (which compiles and writes back). A store
+        warmup then sweeps one cheap render per pose count that pads into
+        a warmed bucket: the render programs are loaded, but the
+        post-dispatch output slice/fetch for a REMAINDER count still
+        compiles lazily per count, and on a truly cold replica those tiny
+        compiles would otherwise land on the first odd-sized requests
+        (cold-p99 must ~= warm-p99, the ROADMAP metric). `entries_counts`
+        extends coverage to multi-entry buckets (the coalesced
+        render_many path); the default matches the historic single-entry
+        warmup."""
         from mine_tpu.utils import configure_compile_cache
         configure_compile_cache()
         if pose_counts is None:
@@ -422,5 +546,34 @@ class RenderEngine:
             while b <= self.max_bucket:
                 pose_counts.append(b)
                 b *= 2
-        for n in pose_counts:
-            self.render(image_id, _identity_poses(n), warp_impl=warp_impl)
+        warp = warp_impl or self.warp_impl
+        entry = (self._entry(image_id)
+                 if self.aot_store is not None
+                 or any(r > 1 for r in entries_counts) else None)
+        for r in entries_counts:
+            for n in pose_counts:
+                if self.aot_store is not None:
+                    Rb = pow2_bucket(r)
+                    Pb = max(pow2_bucket(n), self._min_pose_bucket)
+                    dtype = str(entry.planes.dtype)
+                    bucket = (Rb, Pb, warp, dtype)
+                    if bucket in self._seen_buckets:
+                        continue
+                    S, _, H, W = entry.planes.shape
+                    key = self._aval_key(Rb, Pb, warp, dtype, S, H, W,
+                                         entry.scales is not None)
+                    if self._register_store_hit(bucket, key):
+                        continue
+                if r == 1:
+                    self.render(image_id, _identity_poses(n),
+                                warp_impl=warp_impl)
+                else:
+                    self._call([entry] * r, np.zeros(n, np.int32),
+                               _identity_poses(n), warp_impl)
+        if self.aot_store is not None and pose_counts:
+            limit = min(self.max_bucket,
+                        max(max(pow2_bucket(n), self._min_pose_bucket)
+                            for n in pose_counts))
+            for n in range(1, limit + 1):
+                self.render(image_id, _identity_poses(n),
+                            warp_impl=warp_impl)

@@ -34,6 +34,7 @@ from typing import Callable, List, Optional
 from mine_tpu import telemetry
 from mine_tpu.analysis.locks import ordered_lock
 from mine_tpu.serve.admission import AdmissionController
+from mine_tpu.serve.aot import AOTStore
 from mine_tpu.serve.batcher import ContinuousBatcher, MicroBatcher
 from mine_tpu.serve.cache import MPICache, MPIEntry
 from mine_tpu.serve.shardmap import MeshRenderEngine
@@ -372,15 +373,21 @@ class ServeFleet:
                  admission_inflight_high: int = 256,
                  admission_shed_factor: float = 2.0,
                  admission_hysteresis: float = 0.7,
+                 aot_store_dir: str = "",
                  **engine_kw):
         self.cache = ShardedPlaneCache(
             num_shards=cache_shards, capacity_bytes=cache_bytes,
             quant=cache_quant, fail_threshold=shard_fail_threshold)
+        # serve.aot_store_dir: compiled-executable store (serve/aot.py) —
+        # fleet warmup and shard revival boot from artifacts instead of
+        # paying jit per bucket; "" keeps the engine exactly as before
+        self.aot_store = AOTStore(aot_store_dir) if aot_store_dir else None
         self.engine = MeshRenderEngine(
             mesh_batch=mesh_batch, mesh_model=mesh_model, devices=devices,
             max_bucket=max_bucket, cache=self.cache, encode_fn=encode_fn,
             encode_retries=encode_retries,
             encode_backoff_ms=encode_backoff_ms,
+            aot_store=self.aot_store,
             **engine_kw)
         if scheduler not in ("continuous", "micro"):
             raise ValueError(
@@ -449,6 +456,7 @@ class ServeFleet:
                    admission_inflight_high=serve_cfg.admission_inflight_high,
                    admission_shed_factor=serve_cfg.admission_shed_factor,
                    admission_hysteresis=serve_cfg.admission_hysteresis,
+                   aot_store_dir=serve_cfg.aot_store_dir,
                    encode_fn=encode_fn, start=start, devices=devices,
                    **engine_kw)
 
@@ -494,6 +502,18 @@ class ServeFleet:
 
     def warmup(self, image_id: str, **kw) -> None:
         self.engine.warmup(image_id, **kw)
+
+    def revive_shard(self, shard: int,
+                     warm_image_id: Optional[str] = None) -> int:
+        """Bring a dead cache shard back: re-adopt its stragglers
+        (ShardedPlaneCache.mark_alive) and — when `warm_image_id` names a
+        cached entry — re-run the store-aware engine warmup so the revived
+        shard's first requests dispatch pre-compiled executables, never a
+        live jit. Returns the number of re-adopted entries."""
+        moved = self.cache.mark_alive(shard)
+        if warm_image_id is not None:
+            self.engine.warmup(warm_image_id)
+        return moved
 
     def health(self) -> dict:
         """Liveness with a degraded flag (what /healthz serves): the fleet

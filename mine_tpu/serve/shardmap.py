@@ -115,6 +115,12 @@ class MeshRenderEngine(RenderEngine):
     def num_devices(self) -> int:
         return self.mesh.size
 
+    def _mesh_desc(self) -> str:
+        """AOT program-key component (engine._program_key): executables are
+        compiled against committed NamedSharding inputs, so a 2x1 artifact
+        must never be handed to a 1x1 engine (or vice versa)."""
+        return f"{self.mesh_batch}x{self.mesh_model}"
+
     def _render_span_fields(self) -> dict:
         """Request traces rendered here carry the mesh topology, so a
         waterfall read offline still knows which fleet shape it measured."""

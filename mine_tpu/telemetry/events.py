@@ -196,8 +196,11 @@ KIND_FIELDS: Dict[str, tuple] = {
     "trace.span": ("trace", "span", "name", "ms", "t_off_ms"),
     "serve.sync_encode": ("image_id",),
     "serve.bucket_compile": ("entries_bucket", "poses_bucket", "warp_impl",
-                             "dtype", "compile_ms"),
+                             "dtype", "compile_ms", "store_hit"),
     "serve.slo_point": ("offered_qps", "achieved_qps", "p50_ms", "p99_ms"),
+    "serve.coldstart_point": ("cold_p99_on_ms", "cold_p99_off_ms",
+                              "warm_p99_ms", "boot_on_ms", "loads",
+                              "compiles_off", "n_requests"),
     "serve.slo_breach": ("p99_ms", "objective_ms", "window_s"),
     "serve.shard.place": ("image_id", "shard", "shards"),
     "serve.shard.rebalance": ("from_shards", "to_shards", "moved"),

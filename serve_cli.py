@@ -64,7 +64,8 @@ def main():
     from mine_tpu.infer.video import (WARP_BAND, VideoGenerator,
                                       generate_trajectories)
     from mine_tpu.kernels import on_tpu_backend
-    from mine_tpu.serve import MPICache, RenderEngine, ServeFleet
+    from mine_tpu.serve import (AOTStore, MPICache, RenderEngine, ServeFleet,
+                                quantize_weights_int8)
     from mine_tpu.train.step import SynthesisTrainer
     from mine_tpu.utils import make_logger
 
@@ -111,6 +112,14 @@ def main():
         params, batch_stats = restored.params, restored.batch_stats
         logger.info("Restored checkpoint at step %d", int(restored.step))
 
+    if serve_cfg.encoder_quant == "int8":
+        # quantize ONCE here, not per image: VideoGenerator detects an
+        # already-quantized tree and fuses the dequant into its jitted
+        # encode (mine_tpu/serve/encoder.py)
+        params = quantize_weights_int8(params)
+        logger.info("encoder weights quantized to int8 "
+                    "(serve.encoder_quant)")
+
     # ONE engine + cache for the whole run: every VideoGenerator below
     # deposits its encode here, trajectories render through the same
     # compile-once bucketed program (mine_tpu/serve/engine.py). A fleet
@@ -124,6 +133,12 @@ def main():
         is_bg_depth_inf=bool(config.get("mpi.is_bg_depth_inf", False)),
         backend=backend,
         warp_band=WARP_BAND)
+    aot_store = (AOTStore(serve_cfg.aot_store_dir)
+                 if serve_cfg.aot_store_dir else None)
+    if aot_store is not None:
+        logger.info("AOT executable store: %s (%d artifact(s); build "
+                    "offline with tools/aot_warmstore.py)",
+                    aot_store.root, len(aot_store.entries()))
     fleet = None
     ops = None
     if (serve_cfg.mesh_batch * serve_cfg.mesh_model > 1
@@ -150,6 +165,7 @@ def main():
                            quant=serve_cfg.cache_quant),
             encode_retries=serve_cfg.encode_retries,
             encode_backoff_ms=serve_cfg.encode_backoff_ms,
+            aot_store=aot_store,
             **engine_kw)
         slo = telemetry.SLOTracker(objective_ms=serve_cfg.slo_objective_ms,
                                    target=serve_cfg.slo_target,
@@ -173,9 +189,13 @@ def main():
             continue
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
         gen = VideoGenerator(config, params, batch_stats, img,
-                             chunk=serve_cfg.max_bucket, engine=engine)
+                             chunk=serve_cfg.max_bucket, engine=engine,
+                             encoder_quant=serve_cfg.encoder_quant)
         if args.warmup and views == 0:
             engine.warmup(gen.image_id)
+            if engine.aot_store is not None:
+                logger.info("warmup: %d store load(s), %d live compile(s)",
+                            engine.bucket_loads, engine.bucket_compiles)
             t0 = time.perf_counter()  # don't bill compiles to throughput
         name = os.path.basename(path).rsplit(".", 1)[0]
         # one trace per input image (this CLI's unit of request): the

@@ -69,6 +69,7 @@ QUICK = {
     "test_rendering.py::test_alpha_composition_two_planes",
     "test_sampling.py::test_stratified_linspace_bins",
     "test_serve.py::test_lru_eviction_order_under_byte_budget",
+    "test_serve_aot.py::test_key_digest_canonical_and_sensitive",
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
     "test_train.py::test_multistep_lr_schedule",

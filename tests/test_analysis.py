@@ -268,7 +268,7 @@ def test_run_audit_survives_crashing_pass():
 
 @pytest.mark.parametrize("pass_name", [
     "dtype_upcast", "dot_budget", "cost_budget", "recompile_churn",
-    "transfer_guard", "donation", "concurrency"])
+    "transfer_guard", "donation", "concurrency", "aot_staleness"])
 def test_pass_selftest_detects_seeded_violation(pass_name):
     p = passes_mod.pass_by_name(pass_name)
     r = p.selftest()

@@ -140,6 +140,31 @@ def test_render_notes_one_section_per_lever():
     assert "decision: TODO promote / revert / hold" in text
 
 
+def test_aot_coldstart_lever_aliases_serve_coldstart_variant(monkeypatch):
+    """The r06 aot_coldstart lever runs the serve_coldstart bench variant:
+    MINE_TPU_BENCH_VARIANTS must carry the VARIANT name (bench.py keys its
+    payload on it) while the conductor record keeps the lever name."""
+    lever = next(lv for lv in bc.LEVERS if lv["name"] == "aot_coldstart")
+    assert lever["variant"] == "serve_coldstart"
+
+    seen = {}
+
+    def fake_run(cmd, env=None, **kw):
+        seen["variants"] = env["MINE_TPU_BENCH_VARIANTS"]
+
+        class P:
+            returncode = 0
+            stderr = ""
+            stdout = json.dumps(
+                {"value": 4.0, "variants": {"serve_coldstart": 4.0}})
+        return P()
+
+    monkeypatch.setattr(bc.subprocess, "run", fake_run)
+    rec = bc.run_lever(lever, smoke=True, timeout_s=5.0)
+    assert seen["variants"] == "serve_coldstart"
+    assert rec["reading"] == 4.0  # read from the variant's payload entry
+
+
 def test_main_rejects_unknown_lever(capsys):
     assert bc.main(["--levers", "nonsense"]) == 2
     assert "unknown lever" in capsys.readouterr().err

@@ -62,7 +62,7 @@ def test_report_train_only_stream_names_missing_serve_path(tmp_path):
     assert "serve path: no serve/fleet/trace events in this stream." in text
     assert "step-time" in text
     for absent in ("slowest traces", "SLO breaches", "serving fleet",
-                   "serve bucket compiles"):
+                   "serve cold buckets"):
         assert absent not in text
 
 
@@ -125,6 +125,26 @@ def test_report_slo_breach_section():
     text = obs_report.report(events, [])
     assert "SLO breaches (1):" in text
     assert "p99=120.0 ms over objective=50.0 ms" in text
+
+
+def test_report_cold_bucket_split_loads_vs_compiles():
+    """The warmup section splits AOT store loads from live jit compiles
+    and totals each — the cold-start read a fleet operator diffs."""
+    base = dict(entries_bucket=1, poses_bucket=4, warp_impl="xla",
+                dtype="bfloat16")
+    events = [
+        _ev("serve.bucket_compile", compile_ms=800.0, store_hit=False,
+            **base),
+        _ev("serve.bucket_compile", compile_ms=12.0, store_hit=True,
+            **dict(base, poses_bucket=8)),
+        _ev("serve.bucket_compile", compile_ms=9.0, store_hit=True,
+            **dict(base, poses_bucket=2)),
+    ]
+    text = obs_report.report(events, [])
+    assert "serve cold buckets (3: 1 live compile(s), 2 store load(s)):" \
+        in text
+    assert text.count("[load]") == 2 and text.count("[compile]") == 1
+    assert "cold-start: 800 ms live compile, 21 ms store load" in text
 
 
 def test_report_resilience_section():

@@ -1,0 +1,487 @@
+"""Zero-warmup serving (PR 13): the AOT compiled-executable store and the
+int8 serve-side encoder weights.
+
+The load-bearing contracts, each asserted here:
+  * a fresh engine booting against a populated store serves its first
+    request with ZERO live compiles and zero device calls spent on warmup
+    — every bucket registers from a deserialized executable — and the
+    rendered output is BITWISE-identical to a plain no-store engine, per
+    cache quant dtype;
+  * the store is an accelerator, never a correctness dependency: a miss
+    compiles live and writes back; a corrupt artifact warns once, falls
+    back to live jit, and the output stays bitwise-correct;
+  * program keys are content-addressed over canonical JSON — key order
+    never changes the digest, any value change does;
+  * both new config knobs (`serve.aot_store_dir`, `serve.encoder_quant`)
+    default OFF, and an unknown encoder_quant is rejected at config time;
+  * a ServeFleet wired to a store boots warm, and `revive_shard` re-warms
+    a failed-over shard without a single live compile;
+  * `serve.bucket_compile` telemetry carries `store_hit` and the stream
+    stays strict-schema-clean;
+  * int8 encoder weights: symmetric per-channel quantization holds the
+    |w - dq| <= scale/2 elementwise bound, is idempotent, only touches
+    ndim>=2 float leaves, and the default-off path hands back the exact
+    params object (the PR-10/11 parity bar);
+  * tools/aot_warmstore.py end to end in-process: build -> --check green
+    -> seeded stale artifact -> --check red -> --gc -> green again, and a
+    deleted artifact is reported missing.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from mine_tpu.serve import MPICache, RenderEngine, ServeFleet
+from mine_tpu.serve.aot import AOTStore, env_fingerprint, key_digest
+from mine_tpu.serve.encoder import (ENCODER_QUANT_MODES, dequantize_weights,
+                                    is_quantized, make_encode_fn,
+                                    quantize_weights_int8)
+from mine_tpu.telemetry import events as tevents
+
+S = 4
+HW = 8
+POSE = np.eye(4, dtype=np.float32)
+
+
+def _mpi_parts(seed=0):
+    rng = np.random.RandomState(seed)
+    p = rng.uniform(-1, 1, (S, 4, HW, HW)).astype(np.float32)
+    return (p[:, 0:3], p[:, 3:4],
+            np.linspace(1.0, 0.2, S, dtype=np.float32),
+            np.eye(3, dtype=np.float32))
+
+
+def _engine(store=None, quant="bf16", **kw):
+    eng = RenderEngine(cache=MPICache(quant=quant), max_bucket=2,
+                       aot_store=store, **kw)
+    eng.put("img", *_mpi_parts())
+    return eng
+
+
+def _poses(n):
+    out = np.stack([POSE] * n)
+    for i in range(n):
+        out[i, 0, 3] = 0.01 * (i + 1)
+    return out
+
+
+@pytest.fixture
+def event_stream(tmp_path, monkeypatch):
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    yield path
+    tevents.reset()
+
+
+# ---------------- program keys ----------------
+
+def test_key_digest_canonical_and_sensitive():
+    key = {"b": 2, "a": {"y": [1, 2], "x": "s"}}
+    same = {"a": {"x": "s", "y": [1, 2]}, "b": 2}
+    assert key_digest(key) == key_digest(same)
+    assert len(key_digest(key)) == 64
+    assert key_digest(key) != key_digest({**key, "b": 3})
+
+
+def test_env_fingerprint_names_the_environment():
+    fp = env_fingerprint()
+    assert fp["schema"] == "mtpu-aot1"
+    for field in ("jax", "jaxlib", "backend", "devices", "processes"):
+        assert fp[field]
+    # the digest of a program key moves when the environment does
+    base = {"program": "serve_render", "fingerprint": fp}
+    other = {"program": "serve_render",
+             "fingerprint": {**fp, "jax": "0.0.0"}}
+    assert key_digest(base) != key_digest(other)
+
+
+def test_program_key_separates_engine_configs(tmp_path):
+    eng = _engine(store=AOTStore(str(tmp_path)))
+    k1 = eng._program_key(1, 2, "xla", "bfloat16", S, HW, HW, True)
+    k2 = eng._program_key(1, 4, "xla", "bfloat16", S, HW, HW, True)
+    k3 = eng._program_key(1, 2, "xla", "float32", S, HW, HW, False)
+    assert len({key_digest(k) for k in (k1, k2, k3)}) == 3
+    assert k1["mesh"] == "1x1" and k1["program"] == "serve_render"
+    assert k1["fingerprint"] == env_fingerprint()
+
+
+# ---------------- store round-trip: zero-warmup boot ----------------
+
+@pytest.mark.parametrize("quant", ["float32", "bf16", "int8"])
+def test_fresh_engine_boots_from_store_bitwise(tmp_path, quant):
+    """Builder compiles + writes back; a FRESH engine then warms up with
+    zero live compiles and zero device calls, and serves outputs bitwise
+    equal to a plain no-store engine — per cache quant dtype (int8 adds
+    the scales operand to the executable's pytree)."""
+    store_dir = str(tmp_path / "store")
+    builder = _engine(store=AOTStore(store_dir), quant=quant)
+    builder.warmup("img")
+    assert builder.bucket_compiles == 2 and builder.bucket_loads == 0
+    assert builder.aot_store.saves == 2
+
+    fresh_store = AOTStore(store_dir)
+    fresh = _engine(store=fresh_store, quant=quant)
+    fresh.warmup("img")
+    assert fresh.bucket_compiles == 0, "a populated store must not compile"
+    assert fresh.bucket_loads == 2
+    # every bucket registered from a load; the only device work is the
+    # remainder-count sweep (one cheap render per count <= max bucket)
+    # that pre-compiles the post-dispatch output slice/fetch ops
+    assert fresh.device_calls == 2
+    assert fresh_store.hits == 2 and fresh_store.load_errors == 0
+
+    plain = _engine(quant=quant)
+    for n in (1, 2):
+        got_rgb, got_depth = fresh.render("img", _poses(n))
+        ref_rgb, ref_depth = plain.render("img", _poses(n))
+        np.testing.assert_array_equal(np.asarray(got_rgb),
+                                      np.asarray(ref_rgb))
+        np.testing.assert_array_equal(np.asarray(got_depth),
+                                      np.asarray(ref_depth))
+    # serving from the loaded executables never fell back to compiling
+    assert fresh.bucket_compiles == 0
+
+
+def test_store_miss_compiles_live_and_writes_back(tmp_path):
+    store = AOTStore(str(tmp_path / "store"))
+    eng = _engine(store=store)
+    rgb, _ = eng.render("img", _poses(2))
+    assert eng.bucket_compiles == 1 and eng.bucket_loads == 0
+    assert store.misses == 1 and store.saves == 1
+    assert store.stats()["artifacts"] == 1
+    # the write-back is immediately loadable by the next replica
+    twin = _engine(store=AOTStore(str(tmp_path / "store")))
+    rgb2, _ = twin.render("img", _poses(2))
+    assert twin.bucket_loads == 1 and twin.bucket_compiles == 0
+    np.testing.assert_array_equal(np.asarray(rgb), np.asarray(rgb2))
+
+
+def test_corrupt_artifacts_fall_back_warn_once_and_heal(tmp_path, caplog):
+    store_dir = str(tmp_path / "store")
+    builder = _engine(store=AOTStore(store_dir))
+    builder.warmup("img")
+    for name in os.listdir(store_dir):
+        if name.endswith(".aotx"):
+            with open(os.path.join(store_dir, name), "wb") as f:
+                f.write(b"not an executable")
+
+    store = AOTStore(store_dir)
+    eng = _engine(store=store)
+    with caplog.at_level(logging.WARNING, logger="mine_tpu.serve.aot"):
+        eng.warmup("img")
+        ref = _engine()
+        got_rgb, got_depth = eng.render("img", _poses(2))
+    ref_rgb, ref_depth = ref.render("img", _poses(2))
+    np.testing.assert_array_equal(np.asarray(got_rgb), np.asarray(ref_rgb))
+    np.testing.assert_array_equal(np.asarray(got_depth),
+                                  np.asarray(ref_depth))
+    # every bucket fell back to a live compile...
+    assert eng.bucket_compiles == 2 and eng.bucket_loads == 0
+    assert store.load_errors >= 2
+    # ...warning ONCE per artifact even though each digest is probed by
+    # both the warmup registration and the dispatch fallback
+    fallback_warnings = [r for r in caplog.records
+                         if "falling back to live jit" in r.getMessage()]
+    assert len(fallback_warnings) == 2
+    # and the live compiles healed the store for the next replica
+    healed = _engine(store=AOTStore(store_dir))
+    healed.warmup("img")
+    assert healed.bucket_loads == 2 and healed.bucket_compiles == 0
+
+
+def test_store_never_loads_under_mismatched_fingerprint(tmp_path):
+    """An artifact built in another environment hashes to a different name
+    — the current-environment key simply misses, never aliases."""
+    store = AOTStore(str(tmp_path))
+    eng = _engine(store=store)
+    eng.warmup("img")
+    key = eng._program_key(1, 2, eng.warp_impl, "bfloat16", S, HW, HW,
+                           False)
+    stale_key = dict(key, fingerprint={**key["fingerprint"], "jax": "0.0.0"})
+    assert store.contains(key)
+    assert not store.contains(stale_key)
+    assert store.load(stale_key) is None
+
+
+# ---------------- inventory / GC / save failure ----------------
+
+def test_entries_stale_and_gc(tmp_path):
+    store = AOTStore(str(tmp_path))
+    eng = _engine(store=store)
+    eng.warmup("img")
+    ents = store.entries()
+    assert len(ents) == 2 and not any(e["corrupt"] for e in ents)
+    assert store.stale_entries() == []
+
+    # seed one artifact from a different environment + one corrupt sidecar
+    stale_key = {"program": "serve_render",
+                 "fingerprint": {**env_fingerprint(), "jax": "0.0.0"}}
+    d = key_digest(stale_key)
+    art, side = store._paths(d)
+    with open(art, "wb") as f:
+        f.write(b"old world")
+    with open(side, "w") as f:
+        json.dump({"key": stale_key, "nbytes": 9}, f)
+    good = ents[0]["digest"]
+    with open(store._paths(good)[1], "w") as f:
+        f.write("{truncated")
+
+    stale = store.stale_entries()
+    assert {e["digest"] for e in stale} == {d, good}
+    assert any(e["corrupt"] for e in stale)
+    # dry_run reports without deleting
+    assert sorted(store.gc(dry_run=True)) == sorted([d, good])
+    assert len(store.entries()) == 3
+    removed = store.gc()
+    assert sorted(removed) == sorted([d, good])
+    assert len(store.entries()) == 1
+    assert store.stale_entries() == []
+
+
+def test_save_failure_is_contained(tmp_path):
+    store = AOTStore(str(tmp_path))
+    assert store.save({"program": "x"}, object()) is False
+    assert store.save_errors == 1 and store.stats()["artifacts"] == 0
+    with pytest.raises(ValueError):
+        AOTStore("")
+
+
+# ---------------- config knobs ----------------
+
+def test_config_defaults_off_and_validation():
+    from mine_tpu.config import serve_config_from_dict
+    cfg = serve_config_from_dict({})
+    assert cfg.aot_store_dir == ""
+    assert cfg.encoder_quant == "off"
+    on = serve_config_from_dict({"serve.aot_store_dir": "/srv/aot",
+                                 "serve.encoder_quant": "int8"})
+    assert on.aot_store_dir == "/srv/aot" and on.encoder_quant == "int8"
+    # YAML 1.1 parses a bare `off` as boolean False; the loader accepts it
+    assert serve_config_from_dict(
+        {"serve.encoder_quant": False}).encoder_quant == "off"
+    with pytest.raises(ValueError, match="encoder_quant"):
+        serve_config_from_dict({"serve.encoder_quant": "int4"})
+
+
+def test_videogenerator_and_fleet_default_off():
+    import inspect
+    from mine_tpu.infer.video import VideoGenerator
+    sig = inspect.signature(VideoGenerator.__init__)
+    assert sig.parameters["encoder_quant"].default == "off"
+    fleet = ServeFleet(cache_shards=1, max_requests=2, max_wait_ms=1.0,
+                       max_bucket=2, start=False)
+    try:
+        assert fleet.aot_store is None
+        assert fleet.engine.aot_store is None
+    finally:
+        fleet.close()
+
+
+# ---------------- fleet boot + shard revival ----------------
+
+@pytest.mark.slow
+def test_fleet_boots_warm_and_revives_without_compiling(tmp_path):
+    """A 2x1 mesh fleet against a store built by an identically-shaped
+    fleet: boot warms from loads alone, a failover revival stays at zero
+    compiles, and the served output is bitwise equal to a storeless twin
+    (mesh program keys are disjoint from single-device keys)."""
+    store_dir = str(tmp_path / "store")
+    kw = dict(mesh_batch=2, cache_shards=2, max_requests=4,
+              max_wait_ms=2.0, max_bucket=2)
+    builder = ServeFleet(aot_store_dir=store_dir, **kw)
+    try:
+        builder.engine.put("img", *_mpi_parts())
+        builder.engine.warmup("img")
+        assert builder.engine.bucket_compiles > 0
+    finally:
+        builder.close()
+
+    # single-device artifacts must never alias the mesh program
+    single = _engine(store=AOTStore(str(tmp_path / "single")))
+    mesh_key = json.dumps(
+        sorted(k["mesh"] for e in AOTStore(store_dir).entries()
+               for k in [e["key"]]))
+    assert "2x1" in mesh_key and "1x1" not in mesh_key
+    del single
+
+    fleet = ServeFleet(aot_store_dir=store_dir, **kw)
+    plain = ServeFleet(**kw)
+    try:
+        fleet.engine.put("img", *_mpi_parts())
+        plain.engine.put("img", *_mpi_parts())
+        fleet.engine.warmup("img")
+        assert fleet.engine.bucket_compiles == 0
+        assert fleet.engine.bucket_loads > 0
+        fleet.cache.mark_dead(0)
+        moved = fleet.revive_shard(0, warm_image_id="img")
+        assert moved >= 0
+        assert fleet.engine.bucket_compiles == 0, \
+            "shard revival must re-warm from the store"
+        pose = _poses(1)[0]
+        got = fleet.submit("img", pose).result(timeout=30)
+        ref = plain.submit("img", pose).result(timeout=30)
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+    finally:
+        fleet.close()
+        plain.close()
+
+
+# ---------------- telemetry ----------------
+
+def test_bucket_compile_events_carry_store_hit(tmp_path, event_stream):
+    store_dir = str(tmp_path / "store")
+    builder = _engine(store=AOTStore(store_dir))
+    builder.warmup("img")
+    fresh = _engine(store=AOTStore(store_dir))
+    fresh.warmup("img")
+    tevents.reset()
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    with open(event_stream) as f:
+        events = [json.loads(line) for line in f]
+    cold = [e for e in events if e["kind"] == "serve.bucket_compile"]
+    assert len(cold) == 4
+    assert [e["store_hit"] for e in cold] == [False, False, True, True]
+    for e in cold:
+        assert e["compile_ms"] >= 0.0 and e["dtype"] == "bfloat16"
+
+
+# ---------------- int8 encoder weights ----------------
+
+def _param_tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"proj": {"kernel": rng.randn(6, 5).astype(np.float32) * 3.0,
+                     "bias": rng.randn(5).astype(np.float32)},
+            "head": {"kernel": rng.randn(2, 6, 5).astype(np.float32)}}
+
+
+def test_quantize_int8_elementwise_bound_and_leaf_policy():
+    params = _param_tree()
+    q = quantize_weights_int8(params)
+    assert is_quantized(q) and not is_quantized(params)
+    # 1-D bias is NOT quantized (per-channel scales need >= 2 dims)
+    assert isinstance(q["proj"]["bias"], np.ndarray)
+    for path in (("proj", "kernel"), ("head", "kernel")):
+        leaf = q[path[0]][path[1]]
+        assert set(leaf) == {"q", "scale"}
+        assert np.asarray(leaf["q"]).dtype == np.int8
+    d = dequantize_weights(q)
+    for path in (("proj", "kernel"), ("head", "kernel")):
+        w = params[path[0]][path[1]]
+        dq = np.asarray(d[path[0]][path[1]])
+        scale = np.asarray(q[path[0]][path[1]]["scale"])
+        # symmetric round-to-nearest: half a step, per output channel
+        assert np.all(np.abs(w - dq) <= scale / 2 + 1e-7)
+    np.testing.assert_array_equal(d["proj"]["bias"], params["proj"]["bias"])
+
+
+def test_quantize_int8_idempotent():
+    params = _param_tree(seed=1)
+    once = quantize_weights_int8(params)
+    twice = quantize_weights_int8(once)
+    np.testing.assert_array_equal(np.asarray(once["proj"]["kernel"]["q"]),
+                                  np.asarray(twice["proj"]["kernel"]["q"]))
+    np.testing.assert_array_equal(
+        np.asarray(once["proj"]["kernel"]["scale"]),
+        np.asarray(twice["proj"]["kernel"]["scale"]))
+
+
+class _TinyEncoder:
+    """model.apply-compatible stand-in: a linear projection modulated by a
+    batch_stats scalar, returning the (output, aux) pair video.py unpacks."""
+
+    def apply(self, variables, img, disparity, train=False):
+        import jax.numpy as jnp
+        p = variables["params"]["proj"]
+        feat = jnp.tensordot(img, p["kernel"], axes=[[-1], [0]]) + p["bias"]
+        feat = feat * (1.0 + variables["batch_stats"]["gain"])
+        return feat + disparity.sum(), {}
+
+
+def test_make_encode_fn_modes():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    params = {"proj": {"kernel": rng.randn(3, 5).astype(np.float32),
+                       "bias": rng.randn(5).astype(np.float32)}}
+    stats = {"gain": np.float32(0.5)}
+    img = rng.rand(HW, HW, 3).astype(np.float32)
+    disp = np.linspace(1.0, 0.2, S, dtype=np.float32)
+
+    with pytest.raises(ValueError, match="encoder_quant"):
+        make_encode_fn(_TinyEncoder(), params, stats, encoder_quant="int4")
+    assert set(ENCODER_QUANT_MODES) == {"off", "int8"}
+
+    off = make_encode_fn(_TinyEncoder(), params, stats)
+    assert off.quantized is False and off.params is params
+    ref = np.asarray(off(img, disp))
+
+    on = make_encode_fn(_TinyEncoder(), params, stats, encoder_quant="int8")
+    assert on.quantized is True and is_quantized(on.params)
+    got = np.asarray(on(img, disp))
+    # weights move by at most scale/2 per element; the projection contracts
+    # 3 inputs, so the output error stays a small multiple of the step
+    scale = np.asarray(on.params["proj"]["kernel"]["scale"])
+    assert np.abs(got - ref).max() <= 3 * float(scale.max()) * img.max() + 1e-5
+    assert float(np.abs(got - ref).max()) > 0.0  # int8 is not a no-op
+
+    # pre-quantized params short-circuit to the identical executable input
+    pre = make_encode_fn(_TinyEncoder(), quantize_weights_int8(params),
+                         stats, encoder_quant="int8")
+    np.testing.assert_array_equal(np.asarray(pre(img, disp)), got)
+    del jnp
+
+
+# ---------------- tools/aot_warmstore.py ----------------
+
+@pytest.mark.slow
+def test_warmstore_cli_build_check_gc(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import aot_warmstore
+
+    root = str(tmp_path / "store")
+    extra = json.dumps({"serve.max_bucket": 2, "mpi.num_bins_coarse": S,
+                        "data.img_h": HW, "data.img_w": HW})
+    base = ["--store", root, "--extra_config", extra]
+
+    assert aot_warmstore.main(base) == 0
+    out = capsys.readouterr().out
+    assert "built=2" in out and "compiled=2" in out
+    # idempotent: a rebuild loads instead of compiling
+    assert aot_warmstore.main(base) == 0
+    assert "loaded=2 compiled=0" in capsys.readouterr().out
+    assert aot_warmstore.main(base + ["--check"]) == 0
+    assert "missing=0 stale_ok=True" in capsys.readouterr().out
+    assert aot_warmstore.main(base + ["--list"]) == 0
+    assert "stale=0" in capsys.readouterr().out
+
+    # a stale artifact from another environment reddens --check ...
+    stale_key = {"program": "serve_render",
+                 "fingerprint": {**env_fingerprint(), "jax": "0.0.0"}}
+    d = key_digest(stale_key)
+    with open(os.path.join(root, d + ".aotx"), "wb") as f:
+        f.write(b"old world")
+    with open(os.path.join(root, d + ".json"), "w") as f:
+        json.dump({"key": stale_key, "nbytes": 9}, f)
+    assert aot_warmstore.main(base + ["--check"]) == 1
+    assert "stale_ok=False" in capsys.readouterr().out
+    # ... and --gc sweeps exactly it
+    assert aot_warmstore.main(base + ["--gc"]) == 0
+    assert f"removed={d[:16]}" in capsys.readouterr().out
+    assert aot_warmstore.main(base + ["--check"]) == 0
+    capsys.readouterr()
+
+    # a deleted artifact is reported missing
+    victim = [n for n in os.listdir(root) if n.endswith(".aotx")][0]
+    os.unlink(os.path.join(root, victim))
+    assert aot_warmstore.main(base + ["--check"]) == 1
+    assert "missing=1" in capsys.readouterr().out
+
+    assert aot_warmstore.main(["--extra_config", extra]) == 2  # no store
+    capsys.readouterr()

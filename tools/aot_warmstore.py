@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Build / check / GC the serving AOT executable store (mine_tpu/serve/aot).
+
+The serve compile set is BOUNDED: (entries bucket <= serve.max_requests,
+pose bucket <= serve.max_bucket, warp_impl, cache quant dtype, mesh shape)
+— exactly the keys `RenderEngine._call` tracks in `_seen_buckets`. This
+tool enumerates that set from a ServeConfig, lowers + compiles each
+program against a synthetic entry of the configured MPI shape, and
+serializes the executables into the content-addressed artifact store a
+cold replica boots from (README "Zero-warmup boot"):
+
+  build (default)  compile every missing program, write artifacts
+  --check          store completeness (every enumerated key present) +
+                   staleness (every artifact's fingerprint matches the
+                   CURRENT jax version/backend/topology, via the
+                   aot_staleness audit pass) — exit 1 on either, so CI
+                   and a pre-ship hook can gate on it
+  --gc             remove stale/corrupt artifacts (--dry_run to preview)
+  --list           print the store inventory
+
+Usage:
+
+  JAX_PLATFORMS=cpu python tools/aot_warmstore.py --store /srv/aot \
+      --extra_config '{"serve.max_bucket": 8, "serve.cache_quant": "int8"}'
+  python tools/aot_warmstore.py --store /srv/aot --check
+
+Every output line is "key=value"-parseable; the build is idempotent
+(present keys are skipped) and safe to re-run after a jax upgrade — old
+artifacts hash to different names and `--gc` sweeps them.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _pow2s_through(limit: int):
+    out, b = [], 1
+    while b <= limit:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _parse_counts(spec: str, limit: int):
+    if spec == "all":
+        return _pow2s_through(limit)
+    return [int(x) for x in spec.split(",") if x.strip()]
+
+
+def build_engine(serve_cfg, mpi_cfg, store, seed: int = 0):
+    """Engine + synthetic cached entry matching the configured serve
+    topology and MPI shape — enough to lower every program in the compile
+    set without a checkpoint."""
+    import numpy as np
+
+    from mine_tpu.serve.cache import MPICache
+    from mine_tpu.serve.engine import RenderEngine
+    from mine_tpu.serve.shardmap import MeshRenderEngine
+
+    cache = MPICache(quant=serve_cfg.cache_quant)
+    kw = dict(max_bucket=serve_cfg.max_bucket, cache=cache, aot_store=store)
+    if serve_cfg.mesh_batch * serve_cfg.mesh_model > 1:
+        engine = MeshRenderEngine(mesh_batch=serve_cfg.mesh_batch,
+                                  mesh_model=serve_cfg.mesh_model, **kw)
+    else:
+        engine = RenderEngine(**kw)
+    rng = np.random.RandomState(seed)
+    S, H, W = mpi_cfg.num_bins_total, mpi_cfg.img_h, mpi_cfg.img_w
+    engine.put("warmstore",
+               rng.rand(S, 3, H, W).astype(np.float32),
+               rng.rand(S, 1, H, W).astype(np.float32),
+               np.linspace(1.0, 0.2, S, dtype=np.float32),
+               np.asarray([[W, 0, W / 2], [0, H, H / 2], [0, 0, 1]],
+                          np.float32))
+    return engine
+
+
+def expected_keys(engine, warp_impl, pose_counts, entries_counts):
+    """The program keys `engine.warmup` would resolve — the completeness
+    contract `--check` verifies (same bucket math as engine._call)."""
+    from mine_tpu.serve.engine import pow2_bucket
+    entry = engine.cache.get("warmstore")
+    S, _, H, W = entry.planes.shape
+    dtype = str(entry.planes.dtype)
+    keys, seen = [], set()
+    for r in entries_counts:
+        for n in pose_counts:
+            Rb = pow2_bucket(r)
+            Pb = max(pow2_bucket(n), engine._min_pose_bucket)
+            if (Rb, Pb) in seen:
+                continue
+            seen.add((Rb, Pb))
+            keys.append(engine._program_key(
+                Rb, Pb, warp_impl, dtype, S, H, W,
+                entry.scales is not None))
+    return keys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="build/check/GC the serving AOT executable store")
+    ap.add_argument("--store", type=str, default="",
+                    help="artifact directory (default: serve.aot_store_dir "
+                         "from the config)")
+    ap.add_argument("--config", type=str, default="",
+                    help="dataset YAML (default: params_default.yaml alone)")
+    ap.add_argument("--extra_config", type=str, default="{}",
+                    help="JSON overrides, e.g. "
+                         "'{\"serve.max_bucket\": 8}'")
+    ap.add_argument("--warp_impl", type=str, default="xla",
+                    help="warp backend the executables bake in")
+    ap.add_argument("--poses", type=str, default="all",
+                    help='pose counts to cover: "all" (every pow2 bucket '
+                         '<= serve.max_bucket) or a comma list')
+    ap.add_argument("--entries", type=str, default="1",
+                    help='entry counts to cover: "all" (every pow2 bucket '
+                         '<= serve.max_requests) or a comma list; the '
+                         'default matches engine.warmup')
+    ap.add_argument("--check", action="store_true",
+                    help="verify completeness + staleness; exit 1 on either")
+    ap.add_argument("--gc", action="store_true",
+                    help="remove stale/corrupt artifacts")
+    ap.add_argument("--list", action="store_true",
+                    help="print the store inventory")
+    ap.add_argument("--dry_run", action="store_true",
+                    help="with --gc: report, do not delete")
+    args = ap.parse_args(argv)
+
+    from mine_tpu.config import (CONFIG_DIR, load_config,
+                                 mpi_config_from_dict,
+                                 serve_config_from_dict)
+    from mine_tpu.serve.aot import AOTStore, env_fingerprint
+
+    cfg_path = args.config or os.path.join(CONFIG_DIR, "params_default.yaml")
+    config = load_config(cfg_path, extra_config=args.extra_config)
+    serve_cfg = serve_config_from_dict(config)
+    mpi_cfg = mpi_config_from_dict(config)
+    root = args.store or serve_cfg.aot_store_dir
+    if not root:
+        print("error=no store (--store or serve.aot_store_dir)")
+        return 2
+    store = AOTStore(root)
+    fp = env_fingerprint()
+    print(f"store={root} jax={fp['jax']} backend={fp['backend']} "
+          f"devices={fp['devices']}")
+
+    if args.list:
+        for rec in store.entries():
+            k = rec["key"] or {}
+            print(f"artifact={rec['digest'][:16]} nbytes={rec['nbytes']} "
+                  f"corrupt={rec['corrupt']} "
+                  f"mesh={k.get('mesh', '?')} "
+                  f"R={k.get('entries_bucket', '?')} "
+                  f"P={k.get('poses_bucket', '?')} "
+                  f"dtype={k.get('dtype', '?')} "
+                  f"warp={k.get('warp_impl', '?')}")
+        print(f"artifacts={len(store.entries())} "
+              f"stale={len(store.stale_entries())}")
+        return 0
+
+    if args.gc:
+        removed = store.gc(dry_run=args.dry_run)
+        print(f"gc_removed={len(removed)} dry_run={args.dry_run}")
+        for d in removed:
+            print(f"removed={d[:16]}")
+        return 0
+
+    pose_counts = _parse_counts(args.poses, serve_cfg.max_bucket)
+    entries_counts = _parse_counts(args.entries, serve_cfg.max_requests)
+    engine = build_engine(serve_cfg, mpi_cfg, store)
+    keys = expected_keys(engine, args.warp_impl, pose_counts,
+                         entries_counts)
+
+    if args.check:
+        # completeness: every enumerated program key has an artifact
+        missing = [k for k in keys if not store.contains(k)]
+        for k in missing:
+            print(f"missing=R{k['entries_bucket']}xP{k['poses_bucket']} "
+                  f"dtype={k['dtype']} mesh={k['mesh']}")
+        # staleness: delegate to the audit pass (the same verdict
+        # tools/audit.py gates on under MINE_TPU_AOT_STORE)
+        from mine_tpu.analysis.passes import AOTStalenessPass
+        verdict = AOTStalenessPass(root=root).run_global()
+        print(f"check_expected={len(keys)} missing={len(missing)} "
+              f"stale_ok={verdict.ok} detail={verdict.details!r}")
+        return 0 if not missing and verdict.ok else 1
+
+    # build: engine.warmup resolves every bucket — store hit registers,
+    # miss compiles live and writes back (serve/engine.py)
+    before = store.stats()
+    engine.warmup("warmstore", pose_counts=pose_counts,
+                  warp_impl=args.warp_impl, entries_counts=entries_counts)
+    after = store.stats()
+    print(f"built={after['saves'] - before['saves']} "
+          f"loaded={engine.bucket_loads} compiled={engine.bucket_compiles} "
+          f"artifacts={after['artifacts']} bytes={after['bytes']}")
+    missing = [k for k in keys if not store.contains(k)]
+    if missing:
+        print(f"error=build left {len(missing)} keys missing")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

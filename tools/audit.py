@@ -12,6 +12,9 @@ Passes (mine_tpu/analysis/passes.py):
   transfer_guard   hot paths clean under jax.transfer_guard("disallow")
   donation         donated buffers actually consumed (deleted, no warning)
   concurrency      lock order + thread leaks over a live threaded workload
+  aot_staleness    serving AOT executable store current for this jax
+                   version / backend / topology (MINE_TPU_AOT_STORE;
+                   skips when no store is configured)
 
 Usage:
   python tools/audit.py --gate                # CI gate: everything, exit 1 on any FAIL

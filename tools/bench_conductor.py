@@ -14,6 +14,8 @@ take the conductor down with it:
   renderpass_b4      render-only serving forward
   serve_amortize     encode-amortization curve, --mesh fleet sweep
   serve_slo          open-loop Poisson SLO knee, --mesh, trace-sampled
+  aot_coldstart      cold-replica p99 store-on vs store-off
+                     (bench serve_coldstart variant; reading = speedup)
 
 Outputs (default repo root; --smoke redirects to a temp dir so a harness
 self-test never clobbers checked-in results):
@@ -71,6 +73,7 @@ LEVERS = [
     {"name": "renderpass_b4"},
     {"name": "serve_amortize", "mesh": True},
     {"name": "serve_slo", "mesh": True, "trace_sample": "0.05"},
+    {"name": "aot_coldstart", "variant": "serve_coldstart"},
 ]
 
 PROMOTE_AT = 1.05
@@ -85,7 +88,11 @@ def run_lever(lever, smoke: bool, timeout_s: float):
     cmd = [sys.executable, os.path.join(REPO, "bench.py")]
     if lever.get("mesh"):
         cmd.append("--mesh")
-    env = dict(os.environ, MINE_TPU_BENCH_VARIANTS=lever["name"])
+    # a lever may alias a bench variant under a sweep-facing name
+    # (aot_coldstart -> serve_coldstart); the variant keys the bench
+    # payload, the lever name keys the conductor record
+    variant = lever.get("variant", lever["name"])
+    env = dict(os.environ, MINE_TPU_BENCH_VARIANTS=variant)
     if lever.get("trace_sample"):
         env.setdefault("MINE_TPU_BENCH_TRACE_SAMPLE", lever["trace_sample"])
     if smoke:
@@ -110,7 +117,7 @@ def run_lever(lever, smoke: bool, timeout_s: float):
             except ValueError:
                 pass
             break
-    rec["reading"] = payload_reading(rec["parsed"], lever["name"])
+    rec["reading"] = payload_reading(rec["parsed"], variant)
     return rec
 
 

@@ -147,13 +147,24 @@ def report(events, log_lines):
 
     compiles = [e for e in events if e.get("kind") == "serve.bucket_compile"]
     if compiles:
+        # each cold bucket is either a live jit trace+compile or an AOT
+        # store load (serve/aot.py store_hit field; events predating the
+        # field read as live compiles)
+        loads = [e for e in compiles if e.get("store_hit")]
+        live = [e for e in compiles if not e.get("store_hit")]
         out.append("")
-        out.append("serve bucket compiles (%d):" % len(compiles))
+        out.append("serve cold buckets (%d: %d live compile(s), "
+                   "%d store load(s)):" % (len(compiles), len(live),
+                                           len(loads)))
         for e in compiles:
-            out.append("  R=%-4s P=%-4s %-12s %-10s %8.0f ms"
+            out.append("  R=%-4s P=%-4s %-12s %-10s %8.0f ms  [%s]"
                        % (e.get("entries_bucket"), e.get("poses_bucket"),
                           e.get("warp_impl"), e.get("dtype"),
-                          float(e.get("compile_ms", 0.0))))
+                          float(e.get("compile_ms", 0.0)),
+                          "load" if e.get("store_hit") else "compile"))
+        out.append("  cold-start: %.0f ms live compile, %.0f ms store load"
+                   % (sum(float(e.get("compile_ms", 0.0)) for e in live),
+                      sum(float(e.get("compile_ms", 0.0)) for e in loads)))
 
     places = [e for e in events if e.get("kind") == "serve.shard.place"]
     rebalances = [e for e in events

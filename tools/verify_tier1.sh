@@ -40,7 +40,7 @@ rm -f "$LOG" "$EVENTS"
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     MINE_TPU_TELEMETRY_EVENTS="$EVENTS" python -m pytest tests/ -q -rX \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
-    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+    -p no:xdist -p no:randomly --durations=15 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 
 # every line of the event stream must satisfy the mtpu-ev1 schema — a

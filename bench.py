@@ -210,6 +210,14 @@ VARIANTS = {
     # compile cache is disabled inside this variant's subprocess so the
     # off arm can't cheat by reading this process's own compiles back.
     "serve_coldstart": (1, {}),
+    # STREAMING-SESSION curve (not a train-step variant): a synthetic
+    # drifting video driven through a StreamSession per keyframe cadence
+    # K in {1,2,4,8,16} — frames/s (encode amortized over K) and PSNR vs
+    # the K=1 arm (per-frame encode, the exact reference) as one parseable
+    # stderr line, plus a knee line (largest K holding >= 30 dB). Each arm
+    # asserts the sync-encode invariant: exactly ceil(frames/K) encodes
+    # per session. JSON ips = frames/s at the knee cadence.
+    "stream_session": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -1041,6 +1049,156 @@ def _measure_serve_coldstart(name, steps=MEASURE_STEPS, keep_run=False):
     return speedup, None, (run if keep_run else None), 1
 
 
+# keyframe cadences of the streaming-session sweep
+STREAM_SESSION_CADENCES = (1, 2, 4, 8, 16)
+# knee threshold: largest K whose PSNR vs the per-frame-encode arm holds
+STREAM_SESSION_PSNR_DB = 30.0
+
+
+def _measure_stream_session(name, steps=MEASURE_STEPS, keep_run=False):
+    """Streaming-session cadence sweep (the stream_session variant).
+
+    A synthetic drifting video (the bench batch's source image under a
+    growing brightness gain + a slow dolly) streams through a fresh
+    engine + ContinuousBatcher + StreamSession once per cadence
+    K in STREAM_SESSION_CADENCES. Per arm: frames/s (wall-clock over the
+    whole session, so the ceil(F/K) keyframe encodes are amortized in) and
+    PSNR against the K=1 arm — per-frame encode, bitwise the reference
+    path, so its own PSNR is inf and every K>1 reading is pure temporal-
+    reuse drift. One parseable stderr line ("stream_session curve:
+    K:fps:psnr_db ...") plus a knee line (largest K holding
+    >= STREAM_SESSION_PSNR_DB). Each arm asserts the session invariant:
+    sync_encodes grows by EXACTLY ceil(F/K) per session. JSON ips = the
+    knee arm's frames/s; batch = frames per session."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mine_tpu.kernels import on_tpu_backend
+    from mine_tpu.serve import (ContinuousBatcher, MPICache, RenderEngine,
+                                SessionManager)
+    from mine_tpu.train.step import sample_disparity
+
+    trainer, state, batch = build_variant_program(name)
+    cfg = trainer.cfg
+    max_bucket = 8
+    # >= the largest cadence, so every K arm does DIFFERENT encode work
+    # (ceil(F/K) strictly decreasing) and the fps curve is monotone
+    n_frames = 16 if SMOKE else 48
+    repeats = 1 if SMOKE else 3
+
+    key = jax.random.fold_in(state.rng, state.step)
+    disparity = sample_disparity(jax.random.split(key, 1)[0], 1, cfg)
+    K_src = np.asarray(batch["K_src"][0])
+
+    def encode(img_1hw3, disp):
+        return trainer.model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            img_1hw3, disp, train=False)[0]
+
+    encode_jit = jax.jit(encode)
+
+    def encode_frame(img_hwc):
+        mpi = encode_jit(jnp.asarray(img_hwc, jnp.float32)[None], disparity)
+        return mpi[0, :, 0:3], mpi[0, :, 3:4], disparity[0], K_src
+
+    # synthetic drifting stream: brightness ramp + slow dolly — drift vs
+    # the keyframe grows with age by construction, so the PSNR curve is
+    # monotone in K
+    base = np.asarray(batch["src_img"][0], np.float32)
+    frames = [np.clip(base * (1.0 + 0.02 * i), 0.0, 1.0)
+              for i in range(n_frames)]
+    poses = np.tile(np.eye(4, dtype=np.float32), (n_frames, 1, 1))
+    poses[:, 2, 3] = -0.004 * np.arange(n_frames)
+
+    def one_arm(kf_every):
+        engine = RenderEngine(
+            use_alpha=cfg.use_alpha,
+            is_bg_depth_inf=cfg.is_bg_depth_inf,
+            backend="pallas" if on_tpu_backend() else "xla",
+            warp_band=cfg.warp_band,
+            warp_sep_tol=cfg.warp_sep_tol,
+            max_bucket=max_bucket,
+            cache=MPICache(quant="float32"),
+            encode_fn=encode_frame)
+        # absorb every pose-bucket compile before the timed session
+        engine.put("warm", *encode_frame(frames[0]))
+        engine.warmup("warm")
+        engine.cache.pop("warm")
+        batcher = ContinuousBatcher(engine, max_requests=max_bucket)
+        manager = SessionManager(batcher, keyframe_every=kf_every)
+        expect = -(-n_frames // kf_every)  # ceil
+        try:
+            best, rgb = None, None
+            for _ in range(repeats):
+                before = engine.sync_encodes
+                session = manager.open()
+                t0 = time.perf_counter()
+                futs = [session.process_frame(frames[i], poses[i])
+                        for i in range(n_frames)]
+                out = [f.result() for f in futs]
+                dt = time.perf_counter() - t0
+                stats = session.stats()
+                session.close()
+                got = engine.sync_encodes - before
+                assert got == expect, (
+                    "stream_session[K=%d]: %d sync encodes per session, "
+                    "expected ceil(%d/%d)=%d"
+                    % (kf_every, got, n_frames, kf_every, expect))
+                assert stats["failed_frames"] == 0
+                if best is None or dt < best:
+                    best = dt
+                    rgb = np.stack([r[0] for r in out])
+        finally:
+            manager.close()
+            batcher.close()
+        return n_frames / best, rgb
+
+    curve = []
+    rgb_ref = None
+    for kf_every in STREAM_SESSION_CADENCES:
+        fps, rgb = one_arm(kf_every)
+        if kf_every == 1:
+            rgb_ref = rgb
+            psnr = float("inf")  # the reference arm IS per-frame encode
+        else:
+            mse = float(np.mean((rgb - rgb_ref) ** 2))
+            psnr = 10.0 * math.log10(1.0 / max(mse, 1e-12))
+        curve.append((kf_every, fps, psnr))
+
+    print("  stream_session curve: "
+          + " ".join("%d:%.3f:%s" % (k, fps,
+                                     "ref" if math.isinf(p) else "%.2f" % p)
+                     for k, fps, p in curve)
+          + "  (K:frames_per_sec:psnr_db_vs_K1, %d frames/session)"
+          % n_frames, file=sys.stderr)
+    knee = max((k for k, _, p in curve
+                if p >= STREAM_SESSION_PSNR_DB or math.isinf(p)),
+               default=1)
+    knee_fps = next(fps for k, fps, _ in curve if k == knee)
+    print("  stream_session knee: K=%d (%.3f frames/s, largest cadence "
+          "holding >= %.0f dB vs per-frame encode)"
+          % (knee, knee_fps, STREAM_SESSION_PSNR_DB), file=sys.stderr)
+
+    from mine_tpu import telemetry
+    telemetry.emit("serve.stream_point",
+                   knee_cadence=knee,
+                   knee_fps=round(knee_fps, 3),
+                   n_frames=n_frames,
+                   curve=" ".join("%d:%.3f" % (k, fps)
+                                  for k, fps, _ in curve))
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            one_arm(knee)
+        return time.perf_counter() - t0
+
+    return knee_fps, None, (run if keep_run else None), n_frames
+
+
 def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
     """training.ssim_precision A/B (the ssim_precision_ab variants).
 
@@ -1086,6 +1244,8 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
     if name.startswith("serve_coldstart"):
         return _measure_serve_coldstart(name, steps=steps,
                                         keep_run=keep_run)
+    if name.startswith("stream_session"):
+        return _measure_stream_session(name, steps=steps, keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):

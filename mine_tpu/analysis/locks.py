@@ -28,6 +28,12 @@ from typing import Dict, List, Optional
 # The global acquisition order (ascending = allowed nesting direction).
 # Adding a lock: pick a rank consistent with every path that can hold it
 # together with another instrumented lock, and note the path here.
+#   session manager / session  the streaming-session plane (serve/stream.py,
+#                     serve/session.py): the manager lock guards the session
+#                     table and may create/close sessions (which take their
+#                     own lock), and a session's process_frame holds its lock
+#                     across fleet.submit — so both sit BELOW batcher.cv and
+#                     fleet.cache, manager below session
 #   batcher.cv        held around queue list ops + the admission decision,
 #                     whose edge events nest ASCENDING into telemetry
 #   fleet.cache       guards the shard list / dead set across route, put,
@@ -43,6 +49,8 @@ from typing import Dict, List, Optional
 #   events state->sink  configure() closes the old sink under the state lock
 #                       — the one genuine nesting, hence state < sink
 LOCK_RANKS: Dict[str, int] = {
+    "serve.session.manager": 4,
+    "serve.session": 5,
     "serve.batcher.cv": 10,
     "serve.fleet.cache": 15,
     "telemetry.tracing.ctx": 20,

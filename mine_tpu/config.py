@@ -282,6 +282,24 @@ class ServeConfig:
     # the jitted encode (serve/encoder.py); off is byte-identical to the
     # pre-quantization path
     encoder_quant: str = "off"
+    # serve.session.*: streaming video sessions (serve/session.py) — every
+    # Kth frame keyframe-encodes, the frames between render against the
+    # cached keyframe MPI. keyframe_every=1 (the default) encodes EVERY
+    # frame: bitwise-identical to the per-frame-encode path, i.e. the
+    # feature is effectively off until the cadence is raised.
+    session_keyframe_every: int = 1
+    # serve.session.drift_budget: adaptive re-key threshold; 0 (default)
+    # disables adaptive mode (the fixed cadence alone decides)
+    session_drift_budget: float = 0.0
+    # serve.session.drift_mode: probe (mean |rendered - observed| on a
+    # stride-downsampled probe, causal/lagged) | pose (pose-delta norm
+    # against the keyframe pose, gates the current frame)
+    session_drift_mode: str = "probe"
+    # serve.session.probe_stride: downsample stride of the probe proxy
+    session_probe_stride: int = 4
+    # serve.session.keyframe_tier: priority of keyframe encodes (default
+    # critical — under admission pressure interpolation sheds first)
+    session_keyframe_tier: int = 2
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -318,6 +336,12 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         # YAML 1.1 reads a bare `off` as boolean False — accept it
         encoder_quant=("off" if g("serve.encoder_quant", "off") is False
                        else str(g("serve.encoder_quant", "off"))),
+        session_keyframe_every=int(g("serve.session.keyframe_every", 1)),
+        session_drift_budget=float(
+            g("serve.session.drift_budget", 0.0) or 0.0),
+        session_drift_mode=str(g("serve.session.drift_mode", "probe")),
+        session_probe_stride=int(g("serve.session.probe_stride", 4)),
+        session_keyframe_tier=int(g("serve.session.keyframe_tier", 2)),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -396,6 +420,27 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.encoder_quant must be one of "
             f"{'|'.join(ENCODER_QUANT_MODES)}, got {out.encoder_quant!r}")
+    if out.session_keyframe_every < 1:
+        raise ValueError(
+            f"serve.session.keyframe_every must be >= 1, "
+            f"got {out.session_keyframe_every}")
+    if out.session_drift_budget < 0:
+        raise ValueError(
+            f"serve.session.drift_budget must be >= 0, "
+            f"got {out.session_drift_budget}")
+    from mine_tpu.serve.session import DRIFT_MODES
+    if out.session_drift_mode not in DRIFT_MODES:
+        raise ValueError(
+            f"serve.session.drift_mode must be one of "
+            f"{'|'.join(DRIFT_MODES)}, got {out.session_drift_mode!r}")
+    if out.session_probe_stride < 1:
+        raise ValueError(
+            f"serve.session.probe_stride must be >= 1, "
+            f"got {out.session_probe_stride}")
+    if out.session_keyframe_tier < 0:
+        raise ValueError(
+            f"serve.session.keyframe_tier must be >= 0, "
+            f"got {out.session_keyframe_tier}")
     return out
 
 

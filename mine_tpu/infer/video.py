@@ -31,7 +31,8 @@ from mine_tpu import geometry
 from mine_tpu.config import mpi_config_from_dict, validate_model_shapes
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering
-from mine_tpu.serve import MPICache, RenderEngine, image_id_for
+from mine_tpu.serve import (ContinuousBatcher, MPICache, RenderEngine,
+                            SessionManager, image_id_for)
 from mine_tpu.train.step import sample_disparity
 from mine_tpu.utils import disparity_normalization_vis
 
@@ -110,6 +111,36 @@ def generate_trajectories(dataset_name: str):
     return trajectories, {"fps": fps, "names": names}
 
 
+def _blend_mpi(cfg, backend: str, mpi, img_1hw3, disparity, K_inv):
+    """Source-blend the predicted MPI (the reference infer_network tail):
+    render the blend weights at the source pose and mix the source pixels
+    into the plane RGB. One code path shared by the single-image
+    VideoGenerator and the per-frame streaming encode (StreamRenderer), so
+    both produce bitwise-identical planes for the same pixels."""
+    rgb = mpi[:, :, 0:3]
+    sigma = mpi[:, :, 3:4]
+    H, W = int(img_1hw3.shape[1]), int(img_1hw3.shape[2])
+    grid = geometry.cached_pixel_grid(H, W)
+    xyz_src = geometry.plane_xyz_src(grid, disparity, K_inv)
+    src_nchw = jnp.transpose(img_1hw3, (0, 3, 1, 2))
+    if backend == "pallas" and not cfg.use_alpha:
+        # one fused pass: composite + src rgb blending + blended volume
+        from mine_tpu.kernels import on_tpu_backend
+        from mine_tpu.kernels.composite import fused_src_render_blend
+        _, _, mpi_rgb = fused_src_render_blend(
+            rgb, sigma, xyz_src, src_nchw,
+            is_bg_depth_inf=cfg.is_bg_depth_inf,
+            interpret=not on_tpu_backend())
+    else:
+        _, _, blend_weights, _ = rendering.render(
+            rgb, sigma, xyz_src,
+            use_alpha=cfg.use_alpha,
+            is_bg_depth_inf=cfg.is_bg_depth_inf)
+        mpi_rgb = blend_weights * src_nchw[:, None] + \
+            (1.0 - blend_weights) * rgb
+    return mpi_rgb, sigma
+
+
 class VideoGenerator:
     """Encode one image, then render trajectories in jitted pose chunks."""
 
@@ -161,27 +192,8 @@ class VideoGenerator:
             mpi = encode(self.img, disparity)
         self.disparity = disparity
 
-        grid = geometry.cached_pixel_grid(H, W)
-        xyz_src = geometry.plane_xyz_src(grid, disparity, self.K_inv)
-        rgb = mpi[:, :, 0:3]
-        sigma = mpi[:, :, 3:4]
-        src_nchw = jnp.transpose(self.img, (0, 3, 1, 2))
-        if self.backend == "pallas" and not self.cfg.use_alpha:
-            # one fused pass: composite + src rgb blending + blended volume
-            from mine_tpu.kernels import on_tpu_backend
-            from mine_tpu.kernels.composite import fused_src_render_blend
-            _, _, self.mpi_rgb = fused_src_render_blend(
-                rgb, sigma, xyz_src, src_nchw,
-                is_bg_depth_inf=self.cfg.is_bg_depth_inf,
-                interpret=not on_tpu_backend())
-        else:
-            _, _, blend_weights, _ = rendering.render(
-                rgb, sigma, xyz_src,
-                use_alpha=self.cfg.use_alpha,
-                is_bg_depth_inf=self.cfg.is_bg_depth_inf)
-            self.mpi_rgb = blend_weights * src_nchw[:, None] + \
-                (1.0 - blend_weights) * rgb
-        self.mpi_sigma = sigma
+        self.mpi_rgb, self.mpi_sigma = _blend_mpi(
+            self.cfg, self.backend, mpi, self.img, disparity, self.K_inv)
 
         # hand the encode to the serving engine's cache; trajectories render
         # through its bucketed jitted program (one compile set per warp impl)
@@ -270,6 +282,141 @@ class VideoGenerator:
                                     f"{output_name}_{name}_{tag}")
                 written.append(_write_video(frames, path, meta["fps"]))
         return written
+
+
+class StreamRenderer:
+    """Keyframe-cadenced streaming video over the serving session plane.
+
+    Where `VideoGenerator` encodes ONE image and renders a trajectory from
+    it, this drives a live frame sequence through a `StreamSession`
+    (mine_tpu/serve/session.py): the network runs only at keyframes (every
+    `keyframe_every` frames, or earlier when the drift proxy trips), and
+    every other frame is warp+composite from its keyframe's cached MPI —
+    through the SAME bucketed jitted render program and AOT store static
+    serving uses, so streaming adds no compile surface.
+
+    `keyframe_every=1` degenerates to encode-every-frame and is bitwise
+    identical to the per-frame `VideoGenerator` path (the K=1 parity test
+    in tests/test_stream_session.py pins this).
+
+    Pass `manager=` to ride an existing serving backend (a `ServeFleet`'s
+    SessionManager); by default the renderer owns a private
+    RenderEngine + ContinuousBatcher + SessionManager and closes them.
+    """
+
+    def __init__(self, config: Dict, params, batch_stats,
+                 chunk: int = 8,
+                 dtype=jnp.bfloat16,
+                 seed: int = 0,
+                 backend: Optional[str] = None,
+                 manager: Optional[SessionManager] = None,
+                 cache_quant: str = "float32",
+                 encoder_quant: str = "off",
+                 keyframe_every: int = 1,
+                 drift_budget: float = 0.0,
+                 drift_mode: str = "probe",
+                 probe_stride: int = 4,
+                 max_wait_ms: float = 2.0):
+        self.cfg = mpi_config_from_dict(config)
+        validate_model_shapes(self.cfg)
+        self.config = config
+        if backend is None:
+            from mine_tpu.kernels import on_tpu_backend
+            backend = "pallas" if on_tpu_backend() else "xla"
+        self.backend = backend
+        H, W = self.cfg.img_h, self.cfg.img_w
+
+        self.K = jnp.asarray(geometry.intrinsics_from_fov(H, W, 90.0))[None]
+        self.K_inv = geometry.inverse_intrinsics(self.K)
+
+        model = MPIPredictor(
+            num_layers=self.cfg.num_layers,
+            pos_encoding_multires=self.cfg.pos_encoding_multires,
+            use_alpha=self.cfg.use_alpha,
+            dtype=dtype)
+        # one fixed disparity set for the whole stream (same sampling the
+        # single-image path uses) — keyframes share plane geometry, so the
+        # render program's disparity input never changes shape or value
+        self.disparity = sample_disparity(jax.random.PRNGKey(seed), 1,
+                                          self.cfg)
+        if encoder_quant == "off":
+            variables = {"params": params, "batch_stats": batch_stats}
+
+            def _network(img_1hw3):
+                return model.apply(variables, img_1hw3, self.disparity,
+                                   train=False)[0]
+        else:
+            from mine_tpu.serve.encoder import make_encode_fn
+            encode = make_encode_fn(model, params, batch_stats,
+                                    encoder_quant=encoder_quant)
+
+            def _network(img_1hw3):
+                return encode(img_1hw3, self.disparity)
+
+        def _encode_frame(img_hwc):
+            """engine encode_fn: full network pass + source blend for ONE
+            observed frame — the keyframe path (identical ops to
+            VideoGenerator.__init__, via _blend_mpi)."""
+            img = jnp.asarray(img_hwc, jnp.float32)[None]
+            mpi = _network(img)
+            mpi_rgb, mpi_sigma = _blend_mpi(self.cfg, self.backend, mpi,
+                                            img, self.disparity, self.K_inv)
+            return (mpi_rgb[0], mpi_sigma[0], self.disparity[0], self.K[0])
+
+        self.encode_frame = _encode_frame
+        self._owned_batcher = None
+        if manager is None:
+            engine = RenderEngine(
+                use_alpha=self.cfg.use_alpha,
+                is_bg_depth_inf=self.cfg.is_bg_depth_inf,
+                backend=self.backend,
+                warp_band=WARP_BAND,
+                max_bucket=chunk,
+                cache=MPICache(quant=cache_quant),
+                encode_fn=_encode_frame)
+            self._owned_batcher = ContinuousBatcher(engine,
+                                                    max_requests=chunk,
+                                                    max_wait_ms=max_wait_ms)
+            manager = SessionManager(self._owned_batcher,
+                                     keyframe_every=keyframe_every,
+                                     drift_budget=drift_budget,
+                                     drift_mode=drift_mode,
+                                     probe_stride=probe_stride)
+        self.manager = manager
+        self.last_stats: Optional[dict] = None
+
+    def prepare_frame(self, frame_hwc: np.ndarray) -> np.ndarray:
+        """Resize/normalize one observed frame to the model's [H,W,3] f32."""
+        return np.asarray(
+            _resize_bilinear(frame_hwc, self.cfg.img_h, self.cfg.img_w),
+            np.float32)
+
+    def stream(self, frames, poses_F44: Optional[np.ndarray] = None,
+               session_id: Optional[str] = None):
+        """Drive a frame sequence through one session; returns
+        (rgb [F,3,H,W], disparity [F,1,H,W]) f32 numpy in frame order.
+        `poses_F44` are per-frame camera poses relative to the stream's
+        world (default: identity — re-render each observed viewpoint)."""
+        session = self.manager.open(session_id)
+        futures = []
+        try:
+            for n, frame in enumerate(frames):
+                prepared = self.prepare_frame(np.asarray(frame))
+                pose = None if poses_F44 is None else \
+                    np.asarray(poses_F44[n], np.float32)
+                futures.append(session.process_frame(prepared, pose))
+            results = [f.result() for f in futures]
+        finally:
+            self.last_stats = session.stats()
+            session.close()
+        rgb = np.stack([r[0] for r in results])
+        depth = np.stack([r[1] for r in results])
+        return rgb, np.float32(1.0) / np.maximum(depth, np.float32(1e-8))
+
+    def close(self) -> None:
+        self.manager.close()
+        if self._owned_batcher is not None:
+            self._owned_batcher.close()
 
 
 # ---------------- image helpers ----------------

@@ -14,6 +14,9 @@ asymmetry (README "Serving" / "Sharded serving"):
   shardmap.py  serving mesh ("batch","model") + MeshRenderEngine
   fleet.py     ShardedPlaneCache (key-range partition + failover) +
                ServeFleet
+  session.py   StreamSession — keyframe-cadenced streaming video over the
+               plane cache (shard-sticky ids, drift re-keying)
+  stream.py    SessionManager — concurrent sessions through the batcher
 
 Configured by the serve.* keys (configs/params_default.yaml,
 config.ServeConfig).
@@ -31,6 +34,9 @@ from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   quantize_planes)
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.serve.fleet import ServeFleet, ShardedPlaneCache, shard_for_key
+from mine_tpu.serve.session import (StreamSession, keyframe_id, probe_drift,
+                                    relative_pose, session_key_prefix)
+from mine_tpu.serve.stream import SessionManager
 from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
                                      MeshRenderEngine, make_serve_mesh,
                                      render_shardings)
@@ -39,10 +45,11 @@ __all__ = [
     "AOTStore", "AdmissionController", "ContinuousBatcher",
     "DeadlineExceeded", "MPICache", "MPIEntry", "MeshRenderEngine",
     "MicroBatcher", "PyramidCache", "RenderEngine", "RequestShed",
-    "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS", "ServeFleet",
-    "ShardedPlaneCache", "TIER_BEST_EFFORT", "TIER_CRITICAL",
-    "TIER_STANDARD", "dequantize_planes", "dequantize_weights",
-    "env_fingerprint", "image_id_for", "make_encode_fn", "make_serve_mesh",
-    "pow2_bucket", "quantize_planes", "quantize_weights_int8",
-    "render_shardings", "shard_for_key",
+    "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS", "ServeFleet", "SessionManager",
+    "ShardedPlaneCache", "StreamSession", "TIER_BEST_EFFORT",
+    "TIER_CRITICAL", "TIER_STANDARD", "dequantize_planes",
+    "dequantize_weights", "env_fingerprint", "image_id_for", "keyframe_id",
+    "make_encode_fn", "make_serve_mesh", "pow2_bucket", "probe_drift",
+    "quantize_planes", "quantize_weights_int8", "relative_pose",
+    "render_shardings", "session_key_prefix", "shard_for_key",
 ]

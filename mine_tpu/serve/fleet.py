@@ -180,6 +180,14 @@ class ShardedPlaneCache:
             telemetry.counter(_METRIC_PREFIX + ".owner_hit").inc()
         return entry
 
+    def pop(self, image_id: str) -> Optional[MPIEntry]:
+        """Remove an entry from its (alive) owner shard without counting an
+        eviction — the streaming-session plane retires superseded keyframe
+        MPIs through this (serve/session.py) so a long stream's dead
+        keyframes never crowd the LRU. None when not resident."""
+        with self._lock:
+            return self.shards[self._alive_owner(image_id)].pop(image_id)
+
     def put(self, image_id: str, mpi_rgb_S3HW, mpi_sigma_S1HW,
             disparity_S, K_33, quant: Optional[str] = None) -> MPIEntry:
         """Owner-side placement: the encode result lands on the shard that

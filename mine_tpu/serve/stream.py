@@ -1,0 +1,123 @@
+"""SessionManager: multiplex streaming sessions over the serving fleet.
+
+One manager fronts one backend — a `ServeFleet` (or a bare
+`ContinuousBatcher` / `MicroBatcher`) — and owns the table of live
+`StreamSession`s (serve/session.py). Every session's frames flow through
+the SAME continuous batcher as static serving traffic, so concurrent
+streams coalesce into shared device calls exactly like independent view
+requests do, with keyframe encodes tiered above interpolated renders.
+
+The manager is deliberately thin: per-frame policy (keyframe cadence,
+drift re-keying, retirement) lives in the session; the manager resolves
+the backend's submit/cache surface once, hands sessions their defaults
+(usually `ServeConfig.session_*`, via `from_config`), keeps the
+`serve.session.active` gauge honest, and closes every stream on teardown.
+
+Lock order (analysis/locks.py): the manager lock ("serve.session.manager",
+rank 4) sits below the session lock (5) — `open` creates sessions under it
+— and `close` snapshots the table and closes sessions with NO manager lock
+held, so a closing session's detach callback can re-enter the manager.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional
+
+from mine_tpu import telemetry
+from mine_tpu.analysis.locks import ordered_lock
+from mine_tpu.serve.session import StreamSession
+
+
+def _backend_parts(backend):
+    """(submit, cache) of a session backend: a ServeFleet exposes both
+    directly; a bare batcher reaches its engine's cache. Both submits
+    accept (image_id, pose_44, tier=, image=) and return a Future."""
+    submit = backend.submit
+    cache = getattr(backend, "cache", None)
+    if cache is None:
+        engine = getattr(backend, "engine", None)
+        cache = getattr(engine, "cache", None)
+    return submit, cache
+
+
+class SessionManager:
+    """Open/close streaming sessions against one serving backend."""
+
+    def __init__(self, backend, *,
+                 keyframe_every: int = 1,
+                 drift_budget: float = 0.0,
+                 drift_mode: str = "probe",
+                 probe_stride: int = 4,
+                 keyframe_tier: int = 2):
+        self.backend = backend
+        self._submit, self._cache = _backend_parts(backend)
+        self.defaults = dict(keyframe_every=keyframe_every,
+                             drift_budget=drift_budget,
+                             drift_mode=drift_mode,
+                             probe_stride=probe_stride,
+                             keyframe_tier=keyframe_tier)
+        self._lock = ordered_lock("serve.session.manager")
+        self._sessions: Dict[str, StreamSession] = {}
+
+    @classmethod
+    def from_config(cls, backend, serve_cfg) -> "SessionManager":
+        """Build from a config.ServeConfig's serve.session.* block."""
+        return cls(backend,
+                   keyframe_every=serve_cfg.session_keyframe_every,
+                   drift_budget=serve_cfg.session_drift_budget,
+                   drift_mode=serve_cfg.session_drift_mode,
+                   probe_stride=serve_cfg.session_probe_stride,
+                   keyframe_tier=serve_cfg.session_keyframe_tier)
+
+    def open(self, session_id: Optional[str] = None,
+             key_prefix: Optional[str] = None,
+             **overrides) -> StreamSession:
+        """Start a stream; `overrides` patch the manager defaults
+        (keyframe_every, drift_budget, ...). `key_prefix` pins the
+        session's 8-hex key range explicitly (tests/chaos target a
+        specific owner shard with it); default derives from the id."""
+        sid = str(session_id) if session_id is not None else uuid.uuid4().hex
+        kw = dict(self.defaults)
+        kw.update(overrides)
+        with self._lock:
+            if sid in self._sessions:
+                raise ValueError(f"session {sid!r} is already open")
+            session = StreamSession(sid, self._submit, self._cache,
+                                    key_prefix=key_prefix,
+                                    on_close=self._detach, **kw)
+            self._sessions[sid] = session
+            telemetry.gauge("serve.session.active").set(len(self._sessions))
+        return session
+
+    def _detach(self, session_id: str) -> None:
+        """Session close callback — runs with no session lock held."""
+        with self._lock:
+            self._sessions.pop(session_id, None)
+            telemetry.gauge("serve.session.active").set(len(self._sessions))
+
+    def get(self, session_id: str) -> Optional[StreamSession]:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sessions)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = list(self._sessions.values())
+        return {"active": len(live),
+                "sessions": [s.stats() for s in live]}
+
+    def close(self) -> None:
+        """Close every live session (emitting their session_end events).
+        The backend is NOT closed — the manager never owned it."""
+        with self._lock:
+            live = list(self._sessions.values())
+        for s in live:
+            s.close()

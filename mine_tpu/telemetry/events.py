@@ -209,6 +209,11 @@ KIND_FIELDS: Dict[str, tuple] = {
     "serve.shard_revive": ("shard", "shards", "moved"),
     "metrics.snapshot": ("scope", "metrics"),
     "profile.window": ("start_step", "stop_step", "trace_dir"),
+    "serve.session_start": ("session", "keyframe_every", "drift_mode"),
+    "serve.session_keyframe": ("session", "frame", "image_id", "reason"),
+    "serve.session_frame": ("session", "frame", "age", "drift"),
+    "serve.session_end": ("session", "frames", "keyframes"),
+    "serve.stream_point": ("knee_cadence", "knee_fps", "n_frames"),
 }
 
 

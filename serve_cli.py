@@ -219,16 +219,20 @@ def main():
 
     stats = engine.cache.stats()
     # the fleet's routing counters ride the ONE stats line (a sharded
-    # cache's stats() carries them; a plain MPICache reads as zeros)
+    # cache's stats() carries them; a plain MPICache reads as zeros), and
+    # so do the AOT store's (serve/aot.py; zeros when no store configured)
     logger.info("serve stats: entries=%d nbytes=%d hits=%d misses=%d "
                 "evictions=%d quant=%s device_calls=%d sync_encodes=%d "
                 "owner_hits=%d remote_routes=%d owner_encodes=%d "
-                "rebalances=%d",
+                "rebalances=%d aot_hits=%d aot_misses=%d aot_saves=%d",
                 stats["entries"], stats["nbytes"], stats["hits"],
                 stats["misses"], stats["evictions"], stats["quant"],
                 engine.device_calls, engine.sync_encodes,
                 stats.get("owner_hits", 0), stats.get("remote_routes", 0),
-                stats.get("owner_encodes", 0), stats.get("rebalances", 0))
+                stats.get("owner_encodes", 0), stats.get("rebalances", 0),
+                aot_store.hits if aot_store is not None else 0,
+                aot_store.misses if aot_store is not None else 0,
+                aot_store.saves if aot_store is not None else 0)
     if fleet is not None:
         fs = fleet.stats()
         logger.info("fleet stats: mesh=%s shards=%d slo_breaches=%d "

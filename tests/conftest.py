@@ -72,6 +72,7 @@ QUICK = {
     "test_serve_aot.py::test_key_digest_canonical_and_sensitive",
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
+    "test_stream_session.py::test_keyframe_ids_share_prefix_and_owner_shard",
     "test_train.py::test_multistep_lr_schedule",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
@@ -118,6 +119,10 @@ MEDIUM_FILES = {
     # deadlines, shard failover — all chaos-driven) plus its default-off
     # bitwise parity bar: same reviewer concern as the two above
     "test_serve_resilience.py",
+    # the streaming-session plane over the fleet (keyframe cadence, shard
+    # stickiness, K=1 bitwise parity with per-frame encode): same reviewer
+    # concern as the serve suites above (~30 s)
+    "test_stream_session.py",
     # the telemetry layer's contracts (histogram math, event schema, the
     # frozen st1 step line, bitwise-unchanged instrumented paths): cheap
     # (~25 s) and every other subsystem now routes through it

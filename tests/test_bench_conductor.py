@@ -165,6 +165,41 @@ def test_aot_coldstart_lever_aliases_serve_coldstart_variant(monkeypatch):
     assert rec["reading"] == 4.0  # read from the variant's payload entry
 
 
+def test_stream_session_lever_in_sweep(monkeypatch):
+    """The streaming-session cadence sweep rides the conductor: the lever
+    keys the bench variant of the same name (no alias), and its knee-fps
+    reading is attributed from the variant's own payload entry — never
+    from another lever's headline value."""
+    lever = next(lv for lv in bc.LEVERS if lv["name"] == "stream_session")
+    assert lever.get("variant", lever["name"]) == "stream_session"
+
+    seen = {}
+
+    def fake_run(cmd, env=None, **kw):
+        seen["variants"] = env["MINE_TPU_BENCH_VARIANTS"]
+
+        class P:
+            returncode = 0
+            stderr = "  stream_session knee: K=8 (33.000 frames/s, ...)"
+            stdout = json.dumps(
+                {"value": 33.0, "variants": {"stream_session": 33.0}})
+        return P()
+
+    monkeypatch.setattr(bc.subprocess, "run", fake_run)
+    rec = bc.run_lever(lever, smoke=True, timeout_s=5.0)
+    assert seen["variants"] == "stream_session"
+    assert rec["reading"] == 33.0
+
+    # prior attribution: a wrapper that never measured stream_session
+    # contributes NO prior, even with a numeric headline value
+    wrapper = {"rc": 0, "parsed": {"value": 8.0,
+                                   "variants": {"realloop_b4": 8.0}}}
+    assert bc.prior_reading(wrapper, "stream_session") is None
+    conductor = {"schema": bc.SCHEMA,
+                 "levers": {"stream_session": {"reading": 31.5}}}
+    assert bc.prior_reading(conductor, "stream_session") == 31.5
+
+
 def test_main_rejects_unknown_lever(capsys):
     assert bc.main(["--levers", "nonsense"]) == 2
     assert "unknown lever" in capsys.readouterr().err

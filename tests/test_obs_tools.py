@@ -174,6 +174,42 @@ def test_report_resilience_section_absent_without_its_events():
     assert "resilience" not in text
 
 
+def test_report_sessions_section():
+    events = [
+        _ev("serve.session_start", session="s1", keyframe_every=4,
+            drift_mode="probe", drift_budget=0.05),
+        _ev("serve.session_keyframe", session="s1", frame=0,
+            image_id="aaaa0000bbbb", reason="first"),
+        _ev("serve.session_frame", session="s1", frame=0, age=0, drift=0.0),
+        _ev("serve.session_frame", session="s1", frame=1, age=1,
+            drift=0.0125),
+        _ev("serve.session_keyframe", session="s1", frame=2,
+            image_id="aaaa0000cccc", reason="drift"),
+        _ev("serve.session_frame", session="s1", frame=2, age=0,
+            drift=0.0031),
+        _ev("serve.session_end", session="s1", frames=3, keyframes=2),
+        _ev("span", name="serve.session.keyframe_encode", ms=30.0, ok=True,
+            session="s1"),
+        _ev("span", name="serve.session.interp_render", ms=10.0, ok=True,
+            session="s1"),
+    ]
+    text = obs_report.report(events, [])
+    assert "streaming sessions (keyframe-cadenced temporal reuse):" in text
+    assert "session s1" in text and "K=4" in text and "mode=probe" in text
+    assert "frames=3" in text and "keyframes=2" in text
+    assert "cadence=1.50" in text  # realized frames-per-keyframe
+    assert "last_drift=0.0031" in text
+    assert "drift=1" in text and "first=1" in text  # re-key reason tally
+    # keyframe-encode vs interpolated-render wall-clock split
+    assert "keyframe_encode" in text and "interp_render" in text
+    assert "75.0%" in text and "25.0%" in text
+
+
+def test_report_sessions_section_absent_without_its_events():
+    text = obs_report.report([_ev("span", name="x", ms=1.0)], [])
+    assert "streaming sessions" not in text
+
+
 # ---------------- schema-drift tripwire (validate_events --strict) -------
 
 _EXEMPLAR_VALUES = {
@@ -187,6 +223,9 @@ _EXEMPLAR_VALUES = {
     "trace": "a" * 16,
     "span": "b" * 16,
     "flush_cause": "full",
+    "session": "sess0",
+    "drift_mode": "probe",
+    "reason": "cadence",
 }
 
 

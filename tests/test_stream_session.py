@@ -1,0 +1,380 @@
+"""Streaming sessions (mine_tpu/serve/session.py, stream.py).
+
+The load-bearing contracts, each asserted here:
+  * K=1 streaming is BITWISE-identical to the legacy per-frame-encode
+    path — both against a synthetic engine (put+render loop) and through
+    the real model (StreamRenderer vs VideoGenerator);
+  * exactly ceil(frames/K) sync encodes per session (the keyframe is the
+    ONLY cache miss; interpolated frames never encode);
+  * every keyframe id of a session shares its 8-hex key prefix — one
+    owner shard per stream under any fleet size;
+  * the adaptive policy re-keys on pose-delta (gates the current frame)
+    and on the lagged probe drift (gates the next frame);
+  * superseded keyframes are popped from the cache once their last
+    in-flight frame resolves;
+  * a failed frame is tallied and surfaced, never swallowed;
+  * the manager keeps the session table and active gauge honest.
+"""
+
+import concurrent.futures as cf
+
+import numpy as np
+import pytest
+
+from mine_tpu import telemetry
+from mine_tpu.serve import (ContinuousBatcher, MPICache, RenderEngine,
+                            SessionManager, StreamSession, keyframe_id,
+                            probe_drift, relative_pose, session_key_prefix,
+                            shard_for_key)
+from mine_tpu.serve.session import (REASON_CADENCE, REASON_DRIFT,
+                                    REASON_FIRST, REASON_MANUAL)
+
+S, HW = 4, 16
+
+
+def _encode_fn(img_hwc):
+    """Deterministic synthetic encoder keyed on the image bytes."""
+    rng = np.random.RandomState(int(np.asarray(img_hwc).sum() * 977) % 2**31)
+    p = rng.uniform(-1, 1, (S, 4, HW, HW)).astype(np.float32)
+    return (p[:, 0:3], np.abs(p[:, 3:4]) * 0.3,
+            np.linspace(1.0, 0.2, S, dtype=np.float32),
+            np.array([[HW, 0, HW / 2], [0, HW, HW / 2], [0, 0, 1]],
+                     np.float32))
+
+
+def _frame(seed):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0, 1, (HW, HW, 3)).astype(np.float32)
+
+
+def _engine(quant="float32", max_bucket=4):
+    return RenderEngine(max_bucket=max_bucket, cache=MPICache(quant=quant),
+                        encode_fn=_encode_fn)
+
+
+def _pose(dz=0.0):
+    p = np.eye(4, dtype=np.float32)
+    p[2, 3] = dz
+    return p
+
+
+class _FakeBackend:
+    """Records submits; resolves every future immediately with a fixed
+    render so policy tests run without a device."""
+
+    def __init__(self, rgb=None, fail=False):
+        self.calls = []
+        self.rgb = rgb if rgb is not None else np.zeros((3, HW, HW),
+                                                        np.float32)
+        self.fail = fail
+
+    def submit(self, image_id, pose_44, tier=None, image=None):
+        self.calls.append({"id": image_id, "pose": np.asarray(pose_44),
+                           "tier": tier, "with_image": image is not None})
+        fut = cf.Future()
+        if self.fail:
+            fut.set_exception(RuntimeError("injected"))
+        else:
+            fut.set_result((self.rgb, np.ones((1, HW, HW), np.float32)))
+        return fut
+
+
+# ---------------- id scheme / shard stickiness ----------------
+
+def test_keyframe_ids_share_prefix_and_owner_shard():
+    sid = "stream-abc"
+    prefix = session_key_prefix(sid)
+    assert len(prefix) == 8 and int(prefix, 16) >= 0
+    ids = [keyframe_id(prefix, sid, n) for n in range(64)]
+    assert len(set(ids)) == 64  # unique per keyframe
+    for kid in ids:
+        assert len(kid) == 40 and kid.startswith(prefix)
+    for n_shards in (1, 2, 4, 8):
+        owners = {shard_for_key(kid, n_shards) for kid in ids}
+        assert len(owners) == 1, (
+            f"stream fragments across shards at n={n_shards}: {owners}")
+
+
+def test_relative_pose_and_probe_drift():
+    pose = _pose(-0.5)
+    np.testing.assert_allclose(relative_pose(pose, pose), np.eye(4),
+                               atol=1e-6)
+    r = np.zeros((3, HW, HW), np.float32)
+    o_chw = np.full((3, HW, HW), 0.25, np.float32)
+    assert probe_drift(r, o_chw) == pytest.approx(0.25)
+    # HWC observed frames transpose automatically
+    assert probe_drift(r, np.transpose(o_chw, (1, 2, 0))) == \
+        pytest.approx(0.25)
+    # shape mismatch -> no signal, never a crash
+    assert probe_drift(r, np.zeros((HW * 2, HW * 2, 3), np.float32)) is None
+
+
+# ---------------- per-frame policy (device-free) ----------------
+
+def test_cadence_policy_and_tiering():
+    be = _FakeBackend()
+    s = StreamSession("s", be.submit, keyframe_every=3, keyframe_tier=2)
+    for i in range(7):
+        s.process_frame(_frame(i)).result()
+    s.close()
+    # keyframes at 0, 3, 6; interpolated frames ride WITH keyframe pixels
+    kf = [c for c in be.calls if c["tier"] == 2]
+    assert [be.calls.index(c) for c in kf] == [0, 3, 6]
+    assert all(c["with_image"] for c in be.calls)
+    assert s.stats()["frames"] == 7 and s.stats()["keyframes"] == 3
+    # interpolated frames re-use the CURRENT keyframe's id
+    assert be.calls[1]["id"] == be.calls[0]["id"]
+    assert be.calls[4]["id"] == be.calls[3]["id"]
+
+
+def test_pose_drift_rekeys_current_frame():
+    be = _FakeBackend()
+    s = StreamSession("s", be.submit, keyframe_every=100,
+                      drift_budget=0.1, drift_mode="pose")
+    s.process_frame(_frame(0), _pose(0.0)).result()
+    s.process_frame(_frame(1), _pose(0.05)).result()   # inside budget
+    s.process_frame(_frame(2), _pose(0.5)).result()    # pose delta 0.5 > 0.1
+    s.close()
+    assert s.stats()["keyframes"] == 2 and s.stats()["rekeys"] == 1
+    # the re-keyed frame renders at identity, not at a relative pose
+    np.testing.assert_array_equal(be.calls[2]["pose"],
+                                  np.eye(4, dtype=np.float32))
+
+
+def test_probe_drift_gates_next_frame():
+    """The probe proxy is causal: frame n's measured drift (|rendered -
+    observed| on the downsampled probe) re-keys frame n+1."""
+    be = _FakeBackend(rgb=np.zeros((3, HW, HW), np.float32))
+    s = StreamSession("s", be.submit, keyframe_every=100,
+                      drift_budget=0.2, drift_mode="probe")
+    s.process_frame(np.zeros((HW, HW, 3), np.float32)).result()  # keyframe
+    # interp frame far from the rendered zeros -> large measured drift
+    s.process_frame(np.full((HW, HW, 3), 0.9, np.float32)).result()
+    assert s.last_drift == pytest.approx(0.9)
+    assert s.stats()["keyframes"] == 1  # frame 1 itself was NOT re-keyed
+    s.process_frame(np.full((HW, HW, 3), 0.9, np.float32)).result()
+    s.close()
+    assert s.stats()["keyframes"] == 2 and s.stats()["rekeys"] == 1
+
+
+def test_force_keyframe_and_closed_session():
+    be = _FakeBackend()
+    s = StreamSession("s", be.submit, keyframe_every=100)
+    s.process_frame(_frame(0)).result()
+    s.process_frame(_frame(1), force_keyframe=True).result()
+    assert s.stats()["keyframes"] == 2
+    s.close()
+    s.close()  # idempotent
+    with pytest.raises(RuntimeError):
+        s.process_frame(_frame(2))
+
+
+def test_failed_frame_is_tallied_not_swallowed():
+    be = _FakeBackend(fail=True)
+    s = StreamSession("s", be.submit)
+    fut = s.process_frame(_frame(0))
+    with pytest.raises(RuntimeError, match="injected"):
+        fut.result()
+    assert s.stats()["failed_frames"] == 1
+    s.close()
+
+
+def test_parameter_validation():
+    be = _FakeBackend()
+    for bad in (dict(keyframe_every=0), dict(drift_budget=-1.0),
+                dict(drift_mode="psnr"), dict(probe_stride=0)):
+        with pytest.raises(ValueError):
+            StreamSession("s", be.submit, **bad)
+
+
+# ---------------- the real engine path ----------------
+
+def test_sync_encode_invariant_ceil_frames_over_k():
+    """Exactly ceil(F/K) sync encodes per session: the keyframe is the
+    only cache miss, interpolated frames always hit."""
+    for kf_every, n_frames in ((1, 5), (2, 5), (4, 10), (8, 3)):
+        engine = _engine()
+        batcher = ContinuousBatcher(engine, max_requests=4)
+        manager = SessionManager(batcher, keyframe_every=kf_every)
+        try:
+            session = manager.open()
+            futs = [session.process_frame(_frame(i), _pose(-0.01 * i))
+                    for i in range(n_frames)]
+            for f in futs:
+                rgb, depth = f.result(timeout=30)
+                assert rgb.shape == (3, HW, HW)
+                assert np.isfinite(rgb).all()
+            session.close()
+            expect = -(-n_frames // kf_every)
+            assert engine.sync_encodes == expect, (
+                f"K={kf_every} F={n_frames}: {engine.sync_encodes} encodes,"
+                f" expected {expect}")
+            assert session.stats()["failed_frames"] == 0
+        finally:
+            manager.close()
+            batcher.close()
+
+
+def test_superseded_keyframes_retire_from_cache():
+    engine = _engine()
+    batcher = ContinuousBatcher(engine, max_requests=4)
+    manager = SessionManager(batcher, keyframe_every=2)
+    try:
+        session = manager.open("retire-me")
+        prefix = session.key_prefix
+        futs = [session.process_frame(_frame(i)) for i in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+        # keyframes at 0, 2, 4: the first two are superseded and popped
+        # once their last in-flight frame resolved; the current one stays
+        kids = [keyframe_id(prefix, "retire-me", n) for n in (0, 2, 4)]
+        assert kids[0] not in engine.cache
+        assert kids[1] not in engine.cache
+        assert kids[2] in engine.cache
+        session.close()
+        assert kids[2] not in engine.cache  # close retires the last one
+        assert engine.cache.stats()["entries"] == 0
+    finally:
+        manager.close()
+        batcher.close()
+
+
+def test_k1_streaming_bitwise_matches_per_frame_encode_loop():
+    """THE parity bar: keyframe-every-frame streaming through the batcher
+    produces bitwise-identical pixels to the legacy per-frame encode+render
+    loop on an identical engine (same encode_fn, same cache quant, same
+    jitted render program)."""
+    frames = [_frame(i) for i in range(4)]
+
+    # arm A: legacy loop — encode every frame, render its source view
+    eng_a = _engine()
+    legacy = []
+    for i, frame in enumerate(frames):
+        eng_a.put(f"legacy{i}", *_encode_fn(frame))
+        rgb, depth = eng_a.render(f"legacy{i}",
+                                  np.eye(4, dtype=np.float32)[None])
+        legacy.append((rgb, depth))
+
+    # arm B: K=1 session over an identical fresh engine
+    eng_b = _engine()
+    batcher = ContinuousBatcher(eng_b, max_requests=4)
+    manager = SessionManager(batcher, keyframe_every=1)
+    try:
+        session = manager.open()
+        futs = [session.process_frame(f, _pose(-0.02 * i))
+                for i, f in enumerate(frames)]
+        streamed = [f.result(timeout=30) for f in futs]
+        session.close()
+    finally:
+        manager.close()
+        batcher.close()
+
+    assert eng_b.sync_encodes == len(frames)
+    for (rgb_a, d_a), (rgb_b, d_b) in zip(legacy, streamed):
+        np.testing.assert_array_equal(rgb_a[0], rgb_b)
+        np.testing.assert_array_equal(d_a[0], d_b)
+
+
+def test_k1_stream_renderer_bitwise_matches_video_generator():
+    """End-to-end acceptance gate through the REAL model: infer/video.py's
+    StreamRenderer at keyframe_every=1 reproduces VideoGenerator's frames
+    bitwise (same encode numerics via _blend_mpi, same engine render)."""
+    from mine_tpu.infer.video import StreamRenderer, VideoGenerator
+    from mine_tpu.train.loop import SynthesisTrainer
+    from tests.test_train import tiny_config
+
+    cfg = tiny_config()
+    trainer = SynthesisTrainer(cfg, steps_per_epoch=1)
+    state = trainer.init_state(batch_size=1)
+    params, bstats = state.params, state.batch_stats
+    frame = _frame(7)
+    frame = np.repeat(np.repeat(frame, 4, axis=0), 4, axis=1)  # 64x64
+
+    sr = StreamRenderer(cfg, params, bstats, keyframe_every=1,
+                        cache_quant="float32")
+    try:
+        rgb_s, disp_s = sr.stream([frame],
+                                  np.eye(4, dtype=np.float32)[None])
+    finally:
+        sr.close()
+
+    gen = VideoGenerator(cfg, params, bstats, img_hwc=frame,
+                         cache_quant="float32")
+    rgb_g, disp_g = gen.render_poses(np.eye(4, dtype=np.float32)[None])
+    np.testing.assert_array_equal(rgb_s, rgb_g)
+    np.testing.assert_array_equal(disp_s, disp_g)
+
+
+# ---------------- manager / config ----------------
+
+def test_manager_table_and_active_gauge():
+    be = _FakeBackend()
+    manager = SessionManager(be, keyframe_every=4)
+    assert len(manager) == 0
+    a = manager.open("a")
+    b = manager.open("b", keyframe_every=8)  # per-session override
+    assert a.keyframe_every == 4 and b.keyframe_every == 8
+    assert manager.sessions() == ["a", "b"]
+    assert manager.get("a") is a and manager.get("zz") is None
+    assert telemetry.gauge("serve.session.active").value == 2
+    with pytest.raises(ValueError):
+        manager.open("a")  # duplicate id
+    a.close()  # detaches itself from the table
+    assert manager.sessions() == ["b"]
+    assert telemetry.gauge("serve.session.active").value == 1
+    manager.close()  # closes every remaining session
+    assert len(manager) == 0 and b.closed
+    assert manager.stats()["active"] == 0
+
+
+def test_manager_from_config_and_validation():
+    from mine_tpu.config import serve_config_from_dict
+
+    cfg = serve_config_from_dict({
+        "serve.session.keyframe_every": 6,
+        "serve.session.drift_budget": 0.25,
+        "serve.session.drift_mode": "pose",
+        "serve.session.probe_stride": 2,
+        "serve.session.keyframe_tier": 1,
+    })
+    assert cfg.session_keyframe_every == 6
+    assert cfg.session_drift_budget == 0.25
+    assert cfg.session_drift_mode == "pose"
+    manager = SessionManager.from_config(_FakeBackend(), cfg)
+    s = manager.open()
+    assert s.keyframe_every == 6 and s.drift_mode == "pose"
+    assert s.keyframe_tier == 1 and s.probe_stride == 2
+    manager.close()
+
+    # defaults: K=1 (per-frame encode — streaming effectively off)
+    assert serve_config_from_dict({}).session_keyframe_every == 1
+    for bad in ({"serve.session.keyframe_every": 0},
+                {"serve.session.drift_budget": -0.5},
+                {"serve.session.drift_mode": "psnr"},
+                {"serve.session.probe_stride": 0},
+                {"serve.session.keyframe_tier": -1}):
+        with pytest.raises(ValueError):
+            serve_config_from_dict(bad)
+
+
+def test_session_events_pass_strict_validation(tmp_path):
+    from mine_tpu.telemetry import events as tevents
+
+    path = str(tmp_path / "events.jsonl")
+    tevents.reset()
+    tevents.configure(path)
+    try:
+        be = _FakeBackend()
+        manager = SessionManager(be, keyframe_every=2)
+        session = manager.open("ev")
+        for i in range(4):
+            session.process_frame(_frame(i)).result()
+        manager.close()
+    finally:
+        tevents.reset()
+    assert tevents.validate_file(path, strict_kinds=True) == []
+    kinds = [e["kind"] for e in tevents.read_events(path)]
+    assert kinds.count("serve.session_start") == 1
+    assert kinds.count("serve.session_keyframe") == 2
+    assert kinds.count("serve.session_frame") == 4
+    assert kinds.count("serve.session_end") == 1

@@ -16,6 +16,8 @@ take the conductor down with it:
   serve_slo          open-loop Poisson SLO knee, --mesh, trace-sampled
   aot_coldstart      cold-replica p99 store-on vs store-off
                      (bench serve_coldstart variant; reading = speedup)
+  stream_session     streaming-session cadence sweep (fps + PSNR-vs-K1
+                     curve; reading = frames/s at the knee cadence)
 
 Outputs (default repo root; --smoke redirects to a temp dir so a harness
 self-test never clobbers checked-in results):
@@ -74,6 +76,7 @@ LEVERS = [
     {"name": "serve_amortize", "mesh": True},
     {"name": "serve_slo", "mesh": True, "trace_sample": "0.05"},
     {"name": "aot_coldstart", "variant": "serve_coldstart"},
+    {"name": "stream_session"},
 ]
 
 PROMOTE_AT = 1.05

@@ -224,6 +224,64 @@ def report(events, log_lines):
             out.append("  shard %s revived, remapped %s entr(ies) (%s shards)"
                        % (e.get("shard"), e.get("moved"), e.get("shards")))
 
+    starts = [e for e in events if e.get("kind") == "serve.session_start"]
+    s_frames = [e for e in events if e.get("kind") == "serve.session_frame"]
+    s_keys = [e for e in events if e.get("kind") == "serve.session_keyframe"]
+    ends = [e for e in events if e.get("kind") == "serve.session_end"]
+    if starts or s_frames or s_keys or ends:
+        out.append("")
+        out.append("streaming sessions (keyframe-cadenced temporal reuse):")
+        sids = []
+        for e in starts + s_keys + s_frames + ends:
+            sid = e.get("session")
+            if sid is not None and sid not in sids:
+                sids.append(sid)
+        for sid in sids:
+            cfg_k = next((e.get("keyframe_every") for e in starts
+                          if e.get("session") == sid), "?")
+            mode = next((e.get("drift_mode") for e in starts
+                         if e.get("session") == sid), "?")
+            nf = sum(1 for e in s_frames if e.get("session") == sid)
+            nk = sum(1 for e in s_keys if e.get("session") == sid)
+            end = next((e for e in ends if e.get("session") == sid), None)
+            if end is not None:
+                nf = end.get("frames", nf)
+                nk = end.get("keyframes", nk)
+            realized = (float(nf) / nk) if nk else float("nan")
+            reasons = TallyCounter(e.get("reason") for e in s_keys
+                                   if e.get("session") == sid)
+            drifts = [e.get("drift") for e in s_frames
+                      if e.get("session") == sid
+                      and e.get("drift") is not None]
+            line = ("  session %-16s K=%-4s mode=%-5s frames=%-5s "
+                    "keyframes=%-4s cadence=%s"
+                    % (str(sid)[:16], cfg_k, mode, nf, nk,
+                       "n/a" if realized != realized
+                       else "%.2f" % realized))
+            if drifts:
+                line += " last_drift=%.4f" % float(drifts[-1])
+            if reasons:
+                line += "  [" + " ".join(
+                    "%s=%d" % (r, reasons[r])
+                    for r in sorted(reasons, key=str)) + "]"
+            out.append(line)
+        # keyframe-encode vs interpolated-render wall-clock split: the
+        # session path's two span names, straight from the span events
+        split = {}
+        for e in events:
+            if (e.get("kind") == "span" and "ms" in e
+                    and e.get("name") in ("serve.session.keyframe_encode",
+                                          "serve.session.interp_render")):
+                n, tot = split.get(e["name"], (0, 0.0))
+                split[e["name"]] = (n + 1, tot + float(e["ms"]))
+        if split:
+            total_ms = sum(t for _, t in split.values())
+            for name in sorted(split):
+                n, tot = split[name]
+                out.append("  %-32s %5d spans %9.1f ms total (%4.1f%%)"
+                           % (name.rsplit(".", 1)[1], n, tot,
+                              100.0 * tot / max(total_ms, 1e-9)))
+
     breaches = [e for e in events if e.get("kind") == "serve.slo_breach"]
     if breaches:
         out.append("")

@@ -17,6 +17,12 @@ in mine_tpu/testing/faults.py — never by monkeypatching serve code:
             it dead, the engine's bounded encode retry rides each request
             through re-routing, then mark_alive re-adopts the shard. Zero
             failed requests end to end.
+  session   a StreamSession (keyframe cadence K, shard-sticky key prefix)
+            streams frames while its OWNER shard is force-killed
+            mid-stream: the dropped keyframe MPI must transparently
+            re-encode from the pixels riding each interpolated request —
+            zero failed frames, and strictly more sync encodes than the
+            healthy ceil(frames/K).
 
 Every line of output is "phase=<name> key=value ..." (parseable); the run
 exits NONZERO if any invariant breaks:
@@ -26,6 +32,8 @@ exits NONZERO if any invariant breaks:
     means the harness lost its teeth, which must be loud, not green);
   * the failover phase ends with a dead shard un-revived, a lost entry,
     or any failed request;
+  * the session phase drops a frame, fails to re-encode after the owner
+    kill, or ends with the session table non-empty;
   * the funneled event stream fails mtpu-ev1 strict validation.
 
 Usage (CPU is fine — the point is the control plane, not render speed):
@@ -215,6 +223,48 @@ def main():
               f"failovers={fleet.cache.failovers} moved={moved} "
               f"served={sum(v == 'ok' for _, v in fo + post)} "
               f"health={fleet.health()['status']}", flush=True)
+
+        # ---- phase: session ----
+        from mine_tpu.serve import SessionManager
+        kf_every, n_stream = 4, 8
+        sess_victim = 2 % args.shards
+        manager = SessionManager(fleet, keyframe_every=kf_every)
+        # explicit key prefix -> every keyframe id is OWNED by sess_victim
+        # (shard-sticky streams are the property under attack here)
+        session = manager.open(
+            "soak", key_prefix=_key(sess_victim, args.shards, "")[:8])
+        enc_before = fleet.engine.sync_encodes
+        kill_at = kf_every // 2 + 1  # between keyframe 0 and keyframe K
+        outcomes = []
+        for i in range(n_stream):
+            fut = session.process_frame(_image(200 + i), POSE)
+            try:
+                fut.result(timeout=args.timeout_s)
+                outcomes.append("ok")
+            except Exception as exc:  # noqa: BLE001 — tallied, checked below
+                outcomes.append(type(exc).__name__)
+            if i == kill_at - 1:
+                fleet.cache.mark_dead(sess_victim)
+        extra = (fleet.engine.sync_encodes - enc_before
+                 - -(-n_stream // kf_every))
+        check(all(v == "ok" for v in outcomes),
+              f"session frames failed after owner kill: {outcomes}")
+        check(session.stats()["failed_frames"] == 0,
+              f"session recorded failed frames: {session.stats()}")
+        check(extra > 0,
+              "owner kill produced no re-encode: the dropped keyframe was "
+              "never transparently re-keyed "
+              f"(sync_encodes delta {fleet.engine.sync_encodes - enc_before}"
+              f", healthy baseline {-(-n_stream // kf_every)})")
+        session.close()
+        check(len(manager) == 0,
+              f"session table not empty after close: {manager.sessions()}")
+        manager.close()
+        fleet.cache.mark_alive(sess_victim)
+        print(f"phase=session victim={sess_victim} frames={n_stream} "
+              f"K={kf_every} served={sum(v == 'ok' for v in outcomes)} "
+              f"re_encodes={extra} "
+              f"keyframes={session.stats()['keyframes']}", flush=True)
     finally:
         faults.set_plan(None)
         fleet.close()
@@ -223,7 +273,9 @@ def main():
     problems = tevents.validate_file(events_path, strict_kinds=True)
     check(not problems, f"event stream failed strict validation: {problems}")
     kinds = {e["kind"] for e in tevents.read_events(events_path)}
-    for want in ("serve.admission", "serve.shard_dead", "serve.shard_revive"):
+    for want in ("serve.admission", "serve.shard_dead", "serve.shard_revive",
+                 "serve.session_start", "serve.session_keyframe",
+                 "serve.session_frame", "serve.session_end"):
         check(want in kinds, f"expected a {want} event in the stream")
 
     if violations:

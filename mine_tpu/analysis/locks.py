@@ -28,6 +28,15 @@ from typing import Dict, List, Optional
 # The global acquisition order (ascending = allowed nesting direction).
 # Adding a lock: pick a rank consistent with every path that can hold it
 # together with another instrumented lock, and note the path here.
+#   recorder.dump     the flight recorder's bundle writer
+#                     (telemetry/recorder.py): held across state-provider
+#                     callbacks that re-enter fleet/batcher/session locks
+#                     and across the obs.incident emit (tee -> ring, sink)
+#                     — so it must rank BELOW the entire serve plane and
+#                     every telemetry lock
+#   recorder.state    swap-only guard of the module recorder pointer;
+#                     never held while acquiring anything above it except
+#                     trivially ascending reads
 #   session manager / session  the streaming-session plane (serve/stream.py,
 #                     serve/session.py): the manager lock guards the session
 #                     table and may create/close sessions (which take their
@@ -42,6 +51,14 @@ from typing import Dict, List, Optional
 #                     Never held together with batcher.cv (routing happens
 #                     before submit; the flush thread holds neither), so its
 #                     rank only needs to sit below telemetry.
+#   recorder.ring     the flight recorder's ring-buffer Condition: the
+#                     events tee acquires it INSIDE emitters that still
+#                     hold their own lock — _mark_dead emits shard_dead
+#                     under fleet.cache (15), admission transitions emit
+#                     under batcher.cv (10) — so it ranks above both; the
+#                     dump path only ever COPIES under it and releases
+#                     before calling out, so nothing above it is needed
+#                     below 20
 #   tracing ctx       add_span/finish take it, release, then emit events
 #   tracing tracer    start/finish take it alone or after ctx released
 #   slo               record() releases it before setting registry gauges
@@ -49,10 +66,13 @@ from typing import Dict, List, Optional
 #   events state->sink  configure() closes the old sink under the state lock
 #                       — the one genuine nesting, hence state < sink
 LOCK_RANKS: Dict[str, int] = {
+    "telemetry.recorder.dump": 2,
+    "telemetry.recorder.state": 3,
     "serve.session.manager": 4,
     "serve.session": 5,
     "serve.batcher.cv": 10,
     "serve.fleet.cache": 15,
+    "telemetry.recorder.ring": 18,
     "telemetry.tracing.ctx": 20,
     "telemetry.tracing.tracer": 30,
     "telemetry.slo": 40,
@@ -173,8 +193,12 @@ def ordered_condition(name: str,
 # --------------------------------------------------------------- threads
 
 # the thread names the serve plane owns and must JOIN on close() — an
-# alive one after teardown is the unjoined-thread regression (PR-8)
-OWNED_THREAD_NAMES = ("mine-tpu-serve-batcher", "mine-tpu-ops-server")
+# alive one after teardown is the unjoined-thread regression (PR-8).
+# The flight-recorder dump worker and the resource-gauge sampler joined
+# the list with PR 15: both have explicit close() paths.
+OWNED_THREAD_NAMES = ("mine-tpu-serve-batcher", "mine-tpu-ops-server",
+                      "mine-tpu-flight-recorder",
+                      "mine-tpu-resource-sampler")
 
 
 def leaked_threads(baseline=None):

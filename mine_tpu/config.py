@@ -467,6 +467,40 @@ class TelemetryConfig:
     # (telemetry/tracing.py); 0 disables tracing, 1 traces every request.
     # Sampling gates TRACES only — metrics/SLO see every request.
     trace_sample: float = 0.0
+    # telemetry.events_max_mb: rotate the JSONL event stream when it
+    # crosses this size (MiB), keeping telemetry.events_keep rotated
+    # segments; 0 = today's unbounded single file
+    events_max_mb: float = 0.0
+    # telemetry.events_keep: rotated segments retained alongside the live
+    # file (events.jsonl.1 newest ... .K oldest)
+    events_keep: int = 3
+    # telemetry.resource_sample_s: process-vitals sampler cadence in
+    # seconds (telemetry/resource.py: RSS/threads/fds/GC gauges); 0 = off
+    resource_sample_s: float = 0.0
+    # telemetry.recorder.*: the flight recorder (telemetry/recorder.py).
+    # enabled=False constructs nothing — bitwise-parity bar unchanged.
+    recorder_enabled: bool = False
+    # telemetry.recorder.dir: incident bundle directory; "" defaults to
+    # <workspace>/incidents (train) or alongside the events stream (serve)
+    recorder_dir: str = ""
+    # telemetry.recorder.events: ring size of the retained event tail
+    recorder_events: int = 256
+    # telemetry.recorder.steplines: retained recent st1 step lines
+    recorder_steplines: int = 64
+    # telemetry.recorder.snapshots: retained rolling registry snapshots
+    # (the pre-incident baselines tools/postmortem.py diffs against)
+    recorder_snapshots: int = 16
+    # telemetry.recorder.debounce_s: minimum seconds between bundles — a
+    # breach storm inside one window collapses to ONE bundle
+    recorder_debounce_s: float = 60.0
+    # telemetry.recorder.keep: keep-last-K bundle retention
+    recorder_keep: int = 5
+    # telemetry.recorder.arm_profile_steps: after a train-plane dump, arm
+    # a profiler window over the next K steps (0 = off)
+    recorder_arm_profile_steps: int = 0
+    # telemetry.recorder.data_error_burst: trigger a bundle when one log
+    # interval absorbs >= this many NEW data-pipeline errors (0 = off)
+    recorder_data_error_burst: int = 0
 
 
 def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
@@ -482,6 +516,24 @@ def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
         profile_steps=tuple(int(s) for s in steps),
         profile_dir=str(g("telemetry.profile_dir", "") or ""),
         trace_sample=float(g("telemetry.trace_sample", 0.0) or 0.0),
+        events_max_mb=float(g("telemetry.events_max_mb", 0.0) or 0.0),
+        events_keep=int(g("telemetry.events_keep", 3) or 3),
+        resource_sample_s=float(
+            g("telemetry.resource_sample_s", 0.0) or 0.0),
+        recorder_enabled=bool(g("telemetry.recorder.enabled", False)),
+        recorder_dir=str(g("telemetry.recorder.dir", "") or ""),
+        recorder_events=int(g("telemetry.recorder.events", 256) or 256),
+        recorder_steplines=int(
+            g("telemetry.recorder.steplines", 64) or 64),
+        recorder_snapshots=int(
+            g("telemetry.recorder.snapshots", 16) or 16),
+        recorder_debounce_s=float(
+            g("telemetry.recorder.debounce_s", 60.0) or 0.0),
+        recorder_keep=int(g("telemetry.recorder.keep", 5) or 5),
+        recorder_arm_profile_steps=int(
+            g("telemetry.recorder.arm_profile_steps", 0) or 0),
+        recorder_data_error_burst=int(
+            g("telemetry.recorder.data_error_burst", 0) or 0),
     )
     if out.profile_steps and (
             len(out.profile_steps) != 2 or out.profile_steps[0] < 1
@@ -493,6 +545,28 @@ def telemetry_config_from_dict(config: Dict[str, Any]) -> TelemetryConfig:
         raise ValueError(
             f"telemetry.trace_sample must be in [0, 1], "
             f"got {out.trace_sample}")
+    if out.events_max_mb < 0:
+        raise ValueError(
+            f"telemetry.events_max_mb must be >= 0, got {out.events_max_mb}")
+    if out.events_keep < 1:
+        raise ValueError(
+            f"telemetry.events_keep must be >= 1, got {out.events_keep}")
+    if out.resource_sample_s < 0:
+        raise ValueError(
+            f"telemetry.resource_sample_s must be >= 0, "
+            f"got {out.resource_sample_s}")
+    for field, floor in (("recorder_events", 1), ("recorder_steplines", 1),
+                         ("recorder_snapshots", 1), ("recorder_keep", 1),
+                         ("recorder_arm_profile_steps", 0),
+                         ("recorder_data_error_burst", 0)):
+        v = getattr(out, field)
+        if v < floor:
+            key = "telemetry.recorder." + field[len("recorder_"):]
+            raise ValueError(f"{key} must be >= {floor}, got {v}")
+    if out.recorder_debounce_s < 0:
+        raise ValueError(
+            f"telemetry.recorder.debounce_s must be >= 0, "
+            f"got {out.recorder_debounce_s}")
     return out
 
 

@@ -382,6 +382,7 @@ class ServeFleet:
                  admission_shed_factor: float = 2.0,
                  admission_hysteresis: float = 0.7,
                  aot_store_dir: str = "",
+                 recorder=None,
                  **engine_kw):
         self.cache = ShardedPlaneCache(
             num_shards=cache_shards, capacity_bytes=cache_bytes,
@@ -427,15 +428,28 @@ class ServeFleet:
                                    default_tier=default_tier,
                                    request_deadline_ms=request_deadline_ms)
         self._front = itertools.count()
+        # opt-in flight recorder (telemetry/recorder.py): the fleet doesn't
+        # own it (the configuring caller closes it) — it registers its
+        # state/SLO context so triggered bundles capture admission level,
+        # shard health and the SLO window at the moment of the incident,
+        # and feeds the /incidents route below. The recorder's event tee
+        # auto-triggers on this fleet's slo_breach/shard_dead/shed edges.
+        self.recorder = recorder
+        if recorder is not None:
+            recorder.set_slo(self.slo)
+            recorder.add_state_provider("fleet", self.stats)
+            recorder.add_state_provider("health", self.health)
         # opt-in live ops plane; port 0 binds ephemeral (tests), None = off
         self.ops: Optional[OpsServer] = None
         if ops_port is not None:
-            self.ops = OpsServer(port=ops_port, slo=self.slo,
-                                 health=self.health).start()
+            self.ops = OpsServer(
+                port=ops_port, slo=self.slo, health=self.health,
+                incidents=(recorder.list_incidents
+                           if recorder is not None else None)).start()
 
     @classmethod
     def from_config(cls, serve_cfg, encode_fn=None, start: bool = True,
-                    devices=None, **engine_kw) -> "ServeFleet":
+                    devices=None, recorder=None, **engine_kw) -> "ServeFleet":
         """Build from a config.ServeConfig (the serve.* key block).
         serve.ops_port 0 means "no endpoint" at the config surface (the
         ephemeral-port niche is a test concern, not a YAML one)."""
@@ -466,7 +480,7 @@ class ServeFleet:
                    admission_hysteresis=serve_cfg.admission_hysteresis,
                    aot_store_dir=serve_cfg.aot_store_dir,
                    encode_fn=encode_fn, start=start, devices=devices,
-                   **engine_kw)
+                   recorder=recorder, **engine_kw)
 
     def num_devices(self) -> int:
         return self.engine.num_devices()

@@ -23,6 +23,12 @@ coherent, parseable surface:
                shared parser
   profiler.py  opt-in jax.profiler trace windows over exact train-loop step
                ranges (telemetry.profile_steps = [start, stop])
+  recorder.py  flight recorder: bounded ring buffers of the recent past
+               (events/steplines/metric snapshots) that dump atomic
+               incident bundles on triggers — rendered by
+               tools/postmortem.py, listed at /incidents
+  resource.py  opt-in process-vitals sampler thread (RSS, threads, fds,
+               GC) publishing into the registry
   hostsync.py  host_readback(reason): declared device->host syncs — the
                transfer-guard sanitizer's allowlist (tools/audit.py)
 
@@ -32,13 +38,15 @@ device sync — the bitwise-parity tests in tests/test_telemetry.py and
 tests/test_serve_trace_e2e.py hold the package to that.
 """
 
-from mine_tpu.telemetry import tracing
+from mine_tpu.telemetry import recorder, resource, tracing
 from mine_tpu.telemetry.events import (KIND_FIELDS, emit, ensure_configured,
                                        validate_file, validate_line)
 from mine_tpu.telemetry.export import (OpsServer, parse_prometheus,
                                        render_prometheus)
 from mine_tpu.telemetry.hostsync import host_readback, readback_counts
 from mine_tpu.telemetry.profiler import ProfileWindow
+from mine_tpu.telemetry.recorder import FlightRecorder
+from mine_tpu.telemetry.resource import ResourceSampler
 from mine_tpu.telemetry.registry import (REGISTRY, Counter, Gauge, Histogram,
                                          MetricsRegistry, counter,
                                          default_latency_buckets_ms, gauge,
@@ -51,11 +59,13 @@ from mine_tpu.telemetry.stepline import (STEP_KEYS, STEP_SCHEMA, TIME_KEYS,
 from mine_tpu.telemetry.tracing import TraceContext
 
 __all__ = [
-    "KIND_FIELDS", "OpsServer", "REGISTRY", "Counter", "Gauge", "Histogram",
-    "MetricsRegistry", "ProfileWindow", "SLOTracker", "TraceContext",
+    "FlightRecorder", "KIND_FIELDS", "OpsServer", "REGISTRY", "Counter",
+    "Gauge", "Histogram", "MetricsRegistry", "ProfileWindow",
+    "ResourceSampler", "SLOTracker", "TraceContext",
     "STEP_KEYS", "STEP_SCHEMA", "TIME_KEYS", "counter", "current_span_path",
     "default_latency_buckets_ms", "emit", "ensure_configured",
     "format_step_line", "gauge", "histogram", "host_readback", "parse_line",
     "parse_lines", "parse_prometheus", "pow2_buckets", "readback_counts",
-    "render_prometheus", "span", "tracing", "validate_file", "validate_line",
+    "recorder", "render_prometheus", "resource", "span", "tracing",
+    "validate_file", "validate_line",
 ]

@@ -46,15 +46,27 @@ _log = logging.getLogger(__name__)
 
 class EventSink:
     """One append-only JSONL stream. Opens lazily on first emit; any IO
-    failure (open or write) warns once and disables the sink."""
+    failure (open or write) warns once and disables the sink.
 
-    def __init__(self, path: str):
+    Size-capped rotation (telemetry.events_max_mb): with `max_mb` > 0 the
+    stream rotates when it crosses the cap — `path` -> `path.1`,
+    `path.1` -> `path.2`, ... keeping the newest `keep` rotated segments
+    (a long-running fleet no longer grows one JSONL file forever).
+    `max_mb=0` (the default) is today's unbounded behavior. Readers
+    (`read_events`/`validate_file`) walk segments oldest-first via
+    `segment_paths`."""
+
+    def __init__(self, path: str, max_mb: float = 0.0, keep: int = 3):
         self.path = path
+        self.max_bytes = int(float(max_mb) * (1 << 20))
+        self.keep = max(1, int(keep))
         self._lock = ordered_lock("telemetry.events.sink")
         self._file = None
+        self._bytes = 0
         self._broken = False
         self.emitted = 0
         self.dropped = 0
+        self.rotations = 0
 
     def emit(self, kind: str, **fields) -> bool:
         """Append one event; returns False when the sink is broken (the
@@ -72,8 +84,12 @@ class EventSink:
                     if parent:
                         os.makedirs(parent, exist_ok=True)
                     self._file = open(self.path, "a", buffering=1)
+                    self._bytes = self._file.tell()
                 self._file.write(line + "\n")
+                self._bytes += len(line) + 1
                 self.emitted += 1
+                if self.max_bytes and self._bytes >= self.max_bytes:
+                    self._rotate()
                 return True
             except Exception:
                 self._broken = True
@@ -82,6 +98,23 @@ class EventSink:
                     "telemetry event sink failed (%s) — events disabled for "
                     "the rest of the run", self.path, exc_info=True)
                 return False
+
+    def _rotate(self) -> None:
+        """Shift segments up (caller holds the lock; any failure
+        propagates into emit's degrade-to-broken policy). The live file
+        reopens lazily on the next emit."""
+        self._file.close()
+        self._file = None
+        oldest = f"{self.path}.{self.keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._bytes = 0
+        self.rotations += 1
 
     @property
     def broken(self) -> bool:
@@ -114,21 +147,37 @@ _state_lock = ordered_lock("telemetry.events.state")
 _sink: Optional[EventSink] = None
 _env_checked = False
 
+# Optional observer on EVERY module-level emit(), sink configured or not:
+# the flight recorder (telemetry/recorder.py) installs its ring-buffer
+# feed here — a hook slot instead of an import, so events stays the leaf
+# module. Called as fn(kind, fields) BEFORE the sink write, under whatever
+# locks the emitter holds (the recorder's ring rank accounts for that). A
+# raising tee is uninstalled with one warning — same never-kill-the-run
+# policy as the sink.
+_tee = None
 
-def configure(path: Optional[str]) -> Optional[EventSink]:
+
+def set_tee(fn) -> None:
+    global _tee
+    _tee = fn
+
+
+def configure(path: Optional[str], max_mb: float = 0.0,
+              keep: int = 3) -> Optional[EventSink]:
     """Point the process-wide sink at `path` (None disables). Replaces any
     existing sink (closed first). Returns the new sink."""
     global _sink, _env_checked
     with _state_lock:
         if _sink is not None:
             _sink.close()
-        _sink = EventSink(path) if path else None
+        _sink = EventSink(path, max_mb=max_mb, keep=keep) if path else None
         _env_checked = True  # an explicit choice outranks the env default
         return _sink
 
 
-def ensure_configured(default_path: Optional[str] = None
-                      ) -> Optional[EventSink]:
+def ensure_configured(default_path: Optional[str] = None,
+                      max_mb: float = 0.0,
+                      keep: int = 3) -> Optional[EventSink]:
     """Configure only if nothing is configured yet: the env var wins, then
     `default_path`. This is the train-loop/serve_cli entry point — an outer
     harness (tier-1, chaos soak) that exported MINE_TPU_TELEMETRY_EVENTS
@@ -141,7 +190,7 @@ def ensure_configured(default_path: Optional[str] = None
         path = env or default_path
         _env_checked = True
         if path:
-            _sink = EventSink(path)
+            _sink = EventSink(path, max_mb=max_mb, keep=keep)
         return _sink
 
 
@@ -153,8 +202,17 @@ def current_sink() -> Optional[EventSink]:
 def emit(kind: str, **fields) -> bool:
     """Append one event to the process sink. Unconfigured (and no env
     default): a cheap no-op returning False, so instrumented libraries cost
-    nothing when nobody asked for events."""
-    global _sink, _env_checked
+    nothing when nobody asked for events. The recorder tee (when installed)
+    sees the event either way."""
+    global _sink, _env_checked, _tee
+    tee = _tee
+    if tee is not None:
+        try:
+            tee(kind, fields)
+        except Exception:
+            _tee = None
+            _log.warning("telemetry event tee failed — tee uninstalled",
+                         exc_info=True)
     sink = _sink
     if sink is None:
         if _env_checked:
@@ -172,8 +230,9 @@ def emit(kind: str, **fields) -> bool:
 
 
 def reset() -> None:
-    """Tests only: drop the sink and re-arm the env-var check."""
-    global _sink, _env_checked
+    """Tests only: drop the sink and the tee, re-arm the env-var check."""
+    global _sink, _env_checked, _tee
+    _tee = None
     with _state_lock:
         if _sink is not None:
             _sink.close()
@@ -214,6 +273,7 @@ KIND_FIELDS: Dict[str, tuple] = {
     "serve.session_frame": ("session", "frame", "age", "drift"),
     "serve.session_end": ("session", "frames", "keyframes"),
     "serve.stream_point": ("knee_cadence", "knee_fps", "n_frames"),
+    "obs.incident": ("reason", "bundle"),
 }
 
 
@@ -250,28 +310,53 @@ def validate_line(line: str, strict_kinds: bool = False) -> Optional[str]:
     return None
 
 
+def segment_paths(path: str) -> List[str]:
+    """All on-disk segments of a (possibly rotated) stream, oldest-first:
+    `path.K` ... `path.1`, then the live `path`. An unrotated stream is
+    just `[path]` (even when the file is missing — callers keep their
+    existing missing-file behavior)."""
+    rotated = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rotated.append(f"{path}.{i}")
+        i += 1
+    return list(reversed(rotated)) + [path]
+
+
 def validate_file(path: str, max_errors: int = 20,
                   strict_kinds: bool = False) -> List[str]:
-    """-> list of "line N: error" strings (empty = file is schema-clean)."""
+    """-> list of "line N: error" strings (empty = file is schema-clean).
+    Walks rotated segments oldest-first; errors in a rotated segment are
+    prefixed with its basename."""
     errors = []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            err = validate_line(line, strict_kinds=strict_kinds)
-            if err is not None:
-                errors.append(f"line {i}: {err}")
-                if len(errors) >= max_errors:
-                    errors.append("... (truncated)")
-                    break
+    segs = segment_paths(path)
+    for seg in segs:
+        if seg == path and len(segs) > 1 and not os.path.exists(seg):
+            continue  # rotated out, next emit not yet arrived
+        tag = "" if seg == path else os.path.basename(seg) + " "
+        with open(seg) as f:
+            for i, line in enumerate(f, 1):
+                err = validate_line(line, strict_kinds=strict_kinds)
+                if err is not None:
+                    errors.append(f"{tag}line {i}: {err}")
+                    if len(errors) >= max_errors:
+                        errors.append("... (truncated)")
+                        return errors
     return errors
 
 
 def read_events(path: str) -> List[Dict]:
-    """Parse a JSONL event file, skipping invalid lines (the validator is
-    the strict path; readers are lenient so a torn tail line from a killed
-    run doesn't hide the rest of the stream)."""
+    """Parse a JSONL event file — rotated segments included, oldest-first —
+    skipping invalid lines (the validator is the strict path; readers are
+    lenient so a torn tail line from a killed run doesn't hide the rest of
+    the stream)."""
     out = []
-    with open(path) as f:
-        for line in f:
-            if validate_line(line) is None and line.strip():
-                out.append(json.loads(line))
+    segs = segment_paths(path)
+    for seg in segs:
+        if seg == path and len(segs) > 1 and not os.path.exists(seg):
+            continue
+        with open(seg) as f:
+            for line in f:
+                if validate_line(line) is None and line.strip():
+                    out.append(json.loads(line))
     return out

@@ -26,6 +26,11 @@ round-trip through (it validates the grammar we emit, not the full spec).
                     callable's dict: step/epoch position plus an ETA
                     derived from the recent st1 step-time history; 404
                     when no callable is wired
+    /incidents      with an `incidents` callable wired (the flight
+                    recorder's list_incidents — telemetry/recorder.py),
+                    the captured incident bundles newest-first plus the
+                    recorder's trigger/dump/suppression counters; 404
+                    when no recorder is configured
 
 Port 0 binds an ephemeral port (tests read `.port`). Everything here is
 host-side and stdlib-only; request handling never touches jax state — the
@@ -132,7 +137,7 @@ class OpsServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[_registry.MetricsRegistry] = None,
                  slo=None, traces_limit: int = 32, health=None,
-                 progress=None):
+                 progress=None, incidents=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         ops = self
@@ -145,6 +150,9 @@ class OpsServer:
         self.health = health
         # optional () -> dict for /progress (step/epoch/ETA); None = 404
         self.progress = progress
+        # optional () -> dict for /incidents (the flight recorder's
+        # bundle listing); None = 404
+        self.incidents = incidents
 
         class _Handler(BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes,
@@ -175,6 +183,9 @@ class OpsServer:
                         self._send(200, body.encode())
                     elif path == "/progress" and ops.progress is not None:
                         body = json.dumps(ops.progress()) + "\n"
+                        self._send(200, body.encode())
+                    elif path == "/incidents" and ops.incidents is not None:
+                        body = json.dumps(ops.incidents()) + "\n"
                         self._send(200, body.encode())
                     else:
                         self._send(404, b'{"error": "not found"}\n')

@@ -1,0 +1,103 @@
+"""Opt-in process resource gauges: a sampler thread for the registry.
+
+A wedged fleet usually telegraphs itself in process vitals long before a
+request fails — RSS creep (cache leak), thread-count creep (unjoined
+workers), fd exhaustion (socket leak in the ops plane). This module
+publishes those into the shared metrics registry at a fixed cadence so
+`/metrics`, incident bundles and obs_report all see them:
+
+    process.rss_bytes        resident set size (/proc/self/statm; falls
+                             back to ru_maxrss peak where /proc is absent)
+    process.threads          live python threads (threading.active_count)
+    process.open_fds         open descriptors (/proc/self/fd; absent -> -1)
+    process.gc_collections   cumulative gc runs across generations
+    process.gc_pending       objects tracked since the last collection
+
+Default OFF (`telemetry.resource_sample_s: 0`); stdlib-only, host-side,
+and never touches jax — bitwise parity of instrumented runs is unchanged.
+The thread name is registered in analysis.locks.OWNED_THREAD_NAMES, so
+the conftest thread-leak tripwire (and the concurrency audit pass) fail
+any owner that forgets `close()`.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+from typing import Optional
+
+from mine_tpu.telemetry import registry as _registry
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_bytes() -> Optional[float]:
+    try:
+        with open("/proc/self/statm") as f:
+            return float(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, IndexError, ValueError):
+        try:
+            import resource
+            return float(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+        except Exception:
+            return None
+
+
+def open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def sample_once(registry: Optional[_registry.MetricsRegistry] = None) -> None:
+    """One gauge sweep (the sampler's body; also callable directly from
+    tests or a log-cadence hook)."""
+    reg = registry if registry is not None else _registry.REGISTRY
+    rss = rss_bytes()
+    if rss is not None:
+        reg.gauge("process.rss_bytes").set(rss)
+    reg.gauge("process.threads").set(float(threading.active_count()))
+    reg.gauge("process.open_fds").set(float(open_fds()))
+    stats = gc.get_stats()
+    reg.gauge("process.gc_collections").set(
+        float(sum(s.get("collections", 0) for s in stats)))
+    reg.gauge("process.gc_pending").set(float(sum(gc.get_count())))
+
+
+class ResourceSampler:
+    """Daemon sampler thread; construct started, `close()` joins. A
+    non-positive interval constructs a no-op (nothing to close-but-safe),
+    mirroring the ProfileWindow degrade pattern."""
+
+    def __init__(self, interval_s: float,
+                 registry: Optional[_registry.MetricsRegistry] = None):
+        self.interval_s = float(interval_s)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="mine-tpu-resource-sampler")
+            self._thread.start()
+
+    @property
+    def active(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sample_once(self._registry)
+            except Exception:  # a vitals read must never kill the run
+                pass
+            self._stop.wait(self.interval_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -135,7 +135,31 @@ class TrainLoop:
         if self.telem.enabled:
             telemetry.ensure_configured(
                 self.telem.events_path
-                or os.path.join(workspace, "events.jsonl"))
+                or os.path.join(workspace, "events.jsonl"),
+                max_mb=self.telem.events_max_mb,
+                keep=self.telem.events_keep)
+        # flight recorder (telemetry.recorder.*, default off): black-box
+        # rings fed at log cadence below; triggers on guard aborts (via
+        # the events tee), preemption shutdown and data-error bursts
+        # (explicit hooks), and SIGUSR2. Lead host only — one bundle
+        # stream per run, like the profiler windows.
+        self.recorder = None
+        if (self.telem.enabled and self.telem.recorder_enabled
+                and jax.process_index() == 0):
+            self.recorder = telemetry.recorder.configure(
+                self.telem.recorder_dir
+                or os.path.join(workspace, "incidents"),
+                events_tail=self.telem.recorder_events,
+                steplines=self.telem.recorder_steplines,
+                snapshots=self.telem.recorder_snapshots,
+                debounce_s=self.telem.recorder_debounce_s,
+                keep=self.telem.recorder_keep,
+                arm_profile_steps=self.telem.recorder_arm_profile_steps,
+                config=dict(self.config))
+            self.recorder.install_sigusr2()
+        # opt-in process-vitals gauges (telemetry.resource_sample_s)
+        self._resource = telemetry.ResourceSampler(
+            self.telem.resource_sample_s if self.telem.enabled else 0.0)
         # opt-in jax.profiler window over an exact step range, lead host
         # only (a per-host trace dir free-for-all helps nobody)
         self.profile = telemetry.ProfileWindow(
@@ -197,10 +221,15 @@ class TrainLoop:
 
         self._ops_state.update(epochs=epochs, gstep=int(state.step),
                                epoch=start_epoch)
+        if self.recorder is not None:
+            self.recorder.add_state_provider(
+                "train", lambda: dict(self._ops_state))
         if self.ops_port and self.is_lead:
             self._ops = telemetry.OpsServer(
                 port=self.ops_port, health=self._train_health,
-                progress=self._train_progress).start()
+                progress=self._train_progress,
+                incidents=(self.recorder.list_incidents
+                           if self.recorder is not None else None)).start()
             self._log("train ops endpoint at %s" % self._ops.url)
 
         self.preempt.install()
@@ -230,18 +259,32 @@ class TrainLoop:
                       % ("Preemption" if self.preempted else "Final",
                          int(state.step)))
             self.ckpt.wait()
+            if self.preempted and self.recorder is not None:
+                # preemption-shutdown trigger: the emergency checkpoint is
+                # on disk, so the bundle captures the final state the
+                # resumed run will diff against (sync: the process is
+                # about to exit — the worker thread might not get there)
+                self.recorder.trigger("train.preempted",
+                                      gstep=int(state.step))
         finally:
             self.preempt.uninstall()
             self.profile.stop()  # a window whose stop step never arrived
             if self._ops is not None:
                 self._ops.close()  # join before the thread-leak tripwire
                 self._ops = None
+            self._resource.close()
             # one end-of-run registry snapshot into the event stream so
             # obs_report sees final counter values without scraping logs
             telemetry.emit(
                 "metrics.snapshot", scope="train.run_end",
                 gstep=int(state.step),
                 metrics=telemetry.REGISTRY.snapshot())
+            if self.recorder is not None:
+                # after the snapshot emit: the tee puts it in any
+                # triggered-but-pending bundle's tail, then the worker
+                # joins here
+                telemetry.recorder.release(self.recorder)
+                self.recorder = None
         return state
 
     # ---------------- epoch ----------------
@@ -324,7 +367,17 @@ class TrainLoop:
             h2d_ms_acc += sb.h2d_ms
             # profiler window edges (telemetry.profile_steps; cheap int
             # compares when disabled): trace starts before step `start`
-            # dispatches and stops after step `stop` completes
+            # dispatches and stops after step `stop` completes. A flight-
+            # recorder dump may ARM a window over the next K steps
+            # (telemetry.recorder.arm_profile_steps) — retroactive-ish
+            # profiling of an incident's aftermath; an already-armed or
+            # active window is never clobbered.
+            if self.recorder is not None and not self.profile.enabled:
+                k = self.recorder.take_profile_request()
+                if k:
+                    self.profile = telemetry.ProfileWindow(
+                        (gstep + 1, gstep + k),
+                        self.profile.trace_dir, self.logger)
             self.profile.maybe_start(gstep + 1)
             state, metrics = self.trainer.train_step(state, sb.batch)
             step_in_epoch += 1
@@ -681,6 +734,19 @@ class TrainLoop:
                 psnr_tgt=round(float(m.get("psnr_tgt", 0.0)), 4),
                 **{k: round(times[k], 3) for k in TIME_METER_KEYS},
                 data_errors=data_stats["data_errors"])
+            # flight-recorder feeds, log cadence only: the st1 line and a
+            # rolling registry snapshot land in the black-box rings; a
+            # data-error burst past the configured floor trips a bundle
+            # (async — this is the hot loop's logging path)
+            if self.recorder is not None:
+                self.recorder.observe_stepline(step_line)
+                self.recorder.snapshot_metrics(scope="train")
+                burst = self.telem.recorder_data_error_burst
+                delta = self._ops_state["data_errors_delta"]
+                if burst > 0 and delta >= burst:
+                    self.recorder.trigger(
+                        "train.data_error_burst", sync=False, gstep=gstep,
+                        data_errors_delta=int(delta))
             # per-layer-group stats (training.layer_stats): the jitted step
             # returns them as "layers/<group>.<stat>" scalar metrics — they
             # arrived in the same log-cadence readback as everything else.

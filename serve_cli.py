@@ -71,10 +71,6 @@ def main():
 
     os.makedirs(args.output_dir, exist_ok=True)
     logger = make_logger(os.path.join(args.output_dir, "serve.log"))
-    # event stream next to the log (telemetry.events_path / the
-    # MINE_TPU_TELEMETRY_EVENTS env var override both win over this)
-    telemetry.ensure_configured(
-        os.path.join(args.output_dir, "events.jsonl"))
 
     ckpt_dir = os.path.dirname(os.path.abspath(args.checkpoint_path))
     params_yaml = os.path.join(ckpt_dir, "params.yaml")
@@ -88,6 +84,32 @@ def main():
                              extra_config=args.extra_config)
     serve_cfg = serve_config_from_dict(config)
     telem_cfg = telemetry_config_from_dict(config)
+    if telem_cfg.enabled:
+        # event stream next to the log (telemetry.events_path / the
+        # MINE_TPU_TELEMETRY_EVENTS env var override both win over the
+        # output-dir default); size-capped rotation per events_max_mb
+        telemetry.ensure_configured(
+            telem_cfg.events_path
+            or os.path.join(args.output_dir, "events.jsonl"),
+            max_mb=telem_cfg.events_max_mb, keep=telem_cfg.events_keep)
+    recorder = None
+    if telem_cfg.enabled and telem_cfg.recorder_enabled:
+        # flight recorder (telemetry/recorder.py): black-box capture +
+        # triggered incident bundles; the fleet below registers its state
+        recorder = telemetry.recorder.configure(
+            telem_cfg.recorder_dir
+            or os.path.join(args.output_dir, "incidents"),
+            events_tail=telem_cfg.recorder_events,
+            steplines=telem_cfg.recorder_steplines,
+            snapshots=telem_cfg.recorder_snapshots,
+            debounce_s=telem_cfg.recorder_debounce_s,
+            keep=telem_cfg.recorder_keep,
+            config=dict(config))
+        sig = recorder.install_sigusr2()
+        logger.info("flight recorder armed: %s%s", recorder.out_dir,
+                    " (SIGUSR2 -> bundle)" if sig else "")
+    resource_sampler = telemetry.ResourceSampler(
+        telem_cfg.resource_sample_s if telem_cfg.enabled else 0.0)
     if telem_cfg.trace_sample > 0:
         # head-sampled request traces: each sampled request/image emits a
         # trace.span tree into the event stream (telemetry/tracing.py)
@@ -143,7 +165,8 @@ def main():
     ops = None
     if (serve_cfg.mesh_batch * serve_cfg.mesh_model > 1
             or serve_cfg.cache_shards > 1):
-        fleet = ServeFleet.from_config(serve_cfg, start=False, **engine_kw)
+        fleet = ServeFleet.from_config(serve_cfg, start=False,
+                                       recorder=recorder, **engine_kw)
         engine = fleet.engine
         slo = fleet.slo
         ops = fleet.ops  # fleet owns the endpoint (closed by fleet.close)
@@ -170,9 +193,13 @@ def main():
         slo = telemetry.SLOTracker(objective_ms=serve_cfg.slo_objective_ms,
                                    target=serve_cfg.slo_target,
                                    window_s=serve_cfg.slo_window_s)
+        if recorder is not None:
+            recorder.set_slo(slo)
         if serve_cfg.ops_port > 0:
-            ops = telemetry.OpsServer(port=serve_cfg.ops_port,
-                                      slo=slo).start()
+            ops = telemetry.OpsServer(
+                port=serve_cfg.ops_port, slo=slo,
+                incidents=(recorder.list_incidents
+                           if recorder is not None else None)).start()
     if ops is not None:
         logger.info("ops endpoint: %s (/metrics /healthz /slo "
                     "/traces/recent)", ops.url)
@@ -250,6 +277,8 @@ def main():
                    sync_encodes=engine.sync_encodes, **stats)
     telemetry.emit("metrics.snapshot", scope="serve_cli_end",
                    metrics=telemetry.REGISTRY.snapshot("serve."))
+    resource_sampler.close()
+    telemetry.recorder.release(recorder)
 
 
 if __name__ == "__main__":

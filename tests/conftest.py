@@ -66,6 +66,7 @@ QUICK = {
     "test_pipeline.py::test_assembler_matches_sequential",
     "test_plane_scan.py::test_single_plane_shard_degenerates_to_serial",
     "test_realestate10k.py::test_parse_camera_file",
+    "test_recorder.py::test_dump_arms_profiler_request_once",
     "test_rendering.py::test_alpha_composition_two_planes",
     "test_sampling.py::test_stratified_linspace_bins",
     "test_serve.py::test_lru_eviction_order_under_byte_budget",
@@ -131,6 +132,10 @@ MEDIUM_FILES = {
     # tooling: seconds each, same reviewer concern as test_telemetry
     "test_tracing.py",
     "test_obs_tools.py",
+    # the flight recorder's capture/trigger/bundle contracts (tee triggers,
+    # debounce, rotation, postmortem round-trip): cheap, same reviewer
+    # concern as the two above
+    "test_recorder.py",
     # the --fixture end-to-end chain (scene gen -> llff loader -> train ->
     # eval): the closest thing to a real-data rehearsal, gated here so it
     # can't rot (round-4 VERDICT item 8; ~5 min of the tier's budget)

@@ -226,6 +226,7 @@ _EXEMPLAR_VALUES = {
     "session": "sess0",
     "drift_mode": "probe",
     "reason": "cadence",
+    "bundle": "/tmp/incidents/20260101T000000-breach",
 }
 
 
@@ -264,3 +265,56 @@ def test_strict_allows_undocumented_kinds(tmp_path):
     path = _write(tmp_path / "new_kind.jsonl",
                   [_ev("serve.some_future_kind", anything=1)])
     assert validate_events.main([path, "--strict"]) == 0
+
+
+# ---------------- incidents section + the stable --json report ----------
+
+def test_report_incidents_section_points_at_postmortem():
+    events = [_ev("obs.incident", reason="slo_breach",
+                  bundle="/w/incidents/20260101T000000-slo_breach")]
+    text = obs_report.report(events, [])
+    assert "incident bundles captured (1" in text
+    assert "tools/postmortem.py" in text
+    assert "/w/incidents/20260101T000000-slo_breach" in text
+    assert "incident bundles" not in obs_report.report(
+        [_ev("train.step", gstep=1, step_ms=10.0)], [])
+
+
+def test_report_json_stable_dict(tmp_path, capsys):
+    events = [
+        _ev("train.step", gstep=1, step_ms=80.0, device_ms=70.0),
+        _ev("train.step", gstep=2, step_ms=82.0, device_ms=71.0),
+        _ev("span", name="ckpt.save", ms=12.0),
+        _ev("serve.bucket_compile", entries_bucket=8, poses_bucket=4,
+            warp_impl="xla", dtype="bfloat16", compile_ms=321.0,
+            store_hit=True),
+        _ev("serve.slo_breach", p99_ms=91.0, objective_ms=50.0,
+            window_s=30.0),
+        _ev("obs.incident", reason="slo_breach", bundle="/w/inc/b1"),
+    ]
+    d = obs_report.report_json(events, [])
+    assert d["schema"] == "mtpu-obs1"
+    assert d["events"] == len(events)
+    assert d["totals"]["train.step"] == 2
+    assert d["spans"]["ckpt.save"]["count"] == 1
+    assert d["step_time"]["step_ms"]["mean"] == 81.0
+    assert d["bucket_compiles"][0]["store_hit"] is True
+    assert d["slo_breaches"][0]["p99_ms"] == 91.0
+    assert d["incidents"] == [{"ts": events[-1]["ts"],
+                               "reason": "slo_breach",
+                               "bundle": "/w/inc/b1"}]
+    # the CLI face emits the same dict as parseable JSON
+    path = _write(tmp_path / "ev.jsonl", events)
+    assert obs_report.main([path, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["totals"] == d["totals"]
+    assert parsed["incidents"] == d["incidents"]
+
+
+def test_report_json_folds_log_steplines():
+    from mine_tpu.telemetry import format_step_line
+    line = format_step_line({"step_ms": 100.0, "host_wait_ms": 1.0,
+                             "device_ms": 95.0, "h2d_ms": 4.0}, 0)
+    d = obs_report.report_json([], [line])
+    assert d["step_time"]["step_ms"]["count"] == 1
+    assert d["step_time"]["step_ms"]["mean"] == 100.0

@@ -1,0 +1,477 @@
+"""Flight recorder + incident bundles (mine_tpu/telemetry/recorder.py).
+
+The black-box contracts pinned here:
+
+  * a sync trigger writes a COMPLETE mtpu-inc1 bundle — every BUNDLE_FILES
+    member present, manifest pinned, events tail strict-valid — that
+    tools/postmortem.py renders with rc 0, and a corrupted copy is
+    rejected nonzero;
+  * the events tee auto-triggers on exactly the watched kinds/predicates
+    (slo_breach yes, admission shed yes / admit no, failed session frame
+    yes / ok frame no) without any sink configured;
+  * debounce: a breach storm inside one window collapses to ONE bundle
+    (the slot reserved at request time), force bypasses, SIGUSR2 forces;
+  * keep-last-K retention prunes oldest-first;
+  * a dump can arm a profiler window request the train loop consumes once;
+  * obs.incident events land on the configured sink and pass --strict;
+  * /incidents on OpsServer serves list_incidents() live;
+  * the size-capped EventSink rotation keeps bounded `path.K..1` segments
+    and read_events/validate_file walk them oldest-first;
+  * the resource sampler publishes process gauges and joins on close;
+  * LIVE fleet: an SLO breach under real traffic captures a bundle whose
+    events tail carries the breaching requests' trace ids, and a render
+    with the recorder armed is BITWISE identical to one without.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import postmortem  # noqa: E402
+from mine_tpu import telemetry  # noqa: E402
+from mine_tpu.telemetry import events as tevents  # noqa: E402
+from mine_tpu.telemetry import recorder as trecorder  # noqa: E402
+from mine_tpu.telemetry import resource as tresource  # noqa: E402
+from mine_tpu.telemetry import tracing  # noqa: E402
+from mine_tpu.telemetry.export import OpsServer  # noqa: E402
+
+
+@pytest.fixture
+def clean_telemetry(monkeypatch):
+    """No env funnel, no sink, no tee, no tracer — restored afterwards."""
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    trecorder.reset()
+    tevents.reset()
+    tracing.reset()
+    yield
+    trecorder.reset()
+    tevents.reset()
+    tracing.reset()
+
+
+def _rec(tmp_path, **kw):
+    kw.setdefault("debounce_s", 0.0)
+    return trecorder.FlightRecorder(str(tmp_path / "incidents"), **kw)
+
+
+def _feed(rec):
+    for i in range(5):
+        rec.observe("train.step", {"gstep": i, "step_ms": 80.0 + i})
+    rec.observe_stepline(
+        "time: schema=st1 step_ms=81.0 host_wait_ms=1.0 device_ms=79.0 "
+        "h2d_ms=1.0 data_errors=0")
+    rec.snapshot_metrics(scope="test")
+    rec.add_state_provider("train", lambda: {"gstep": 4, "epoch": 1})
+
+
+def _bundles(rec):
+    return sorted(n for n in os.listdir(rec.out_dir)
+                  if not n.startswith(".tmp-"))
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------- bundle capture + postmortem round-trip ----------------
+
+def test_sync_trigger_writes_complete_renderable_bundle(tmp_path,
+                                                        clean_telemetry):
+    rec = _rec(tmp_path, config={"training": {"seed": 3}})
+    try:
+        _feed(rec)
+        bundle = rec.trigger("unit_test", force=True, sync=True, gstep=4)
+    finally:
+        rec.close()
+    assert bundle and os.path.isdir(bundle)
+    for name in trecorder.BUNDLE_FILES:
+        assert os.path.isfile(os.path.join(bundle, name)), name
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["schema"] == trecorder.BUNDLE_SCHEMA
+    assert man["reason"] == "unit_test"
+    assert man["trigger"]["gstep"] == 4
+    assert man["config_hash"] == rec.config_hash
+    assert man["counts"]["events"] == 5
+    # the captured tail is a clean strict stream
+    assert tevents.validate_file(os.path.join(bundle, "events.jsonl"),
+                                 strict_kinds=True) == []
+    # state providers and the config landed
+    with open(os.path.join(bundle, "state.json")) as f:
+        assert json.load(f)["train"]["gstep"] == 4
+    with open(os.path.join(bundle, "config.json")) as f:
+        assert json.load(f)["config"]["training"]["seed"] == 3
+    # one-command postmortem: renders clean, rejects a gutted copy
+    errors, man2 = postmortem.validate_bundle(bundle)
+    assert errors == [] and man2["bundle"] == man["bundle"]
+    assert postmortem.main([bundle]) == 0
+    os.remove(os.path.join(bundle, "slo.json"))
+    assert postmortem.main([bundle]) == 2
+
+
+def test_postmortem_selftest_green(clean_telemetry):
+    assert postmortem.main(["--selftest"]) == 0
+
+
+def test_postmortem_rejects_nonexistent_dir(tmp_path):
+    assert postmortem.main([str(tmp_path / "nope")]) == 2
+
+
+# ---------------- the events tee + auto-trigger table ----------------
+
+def test_tee_auto_triggers_on_watched_kinds_without_sink(tmp_path,
+                                                         clean_telemetry):
+    rec = trecorder.configure(str(tmp_path / "inc"), debounce_s=0.0)
+    try:
+        # no sink configured: the tee still sees every emit
+        tevents.emit("serve.slo_breach", p99_ms=90.0, objective_ms=50.0,
+                     window_s=30.0)
+        assert _wait(lambda: rec.dumps >= 1)
+        with open(os.path.join(rec.out_dir, _bundles(rec)[-1],
+                               "manifest.json")) as f:
+            man = json.load(f)
+        assert man["reason"] == "serve.slo_breach"
+        assert man["trigger"]["kind"] == "serve.slo_breach"
+        assert man["trigger"]["p99_ms"] == 90.0
+    finally:
+        trecorder.reset()
+
+
+@pytest.mark.parametrize("kind,fields,fires", [
+    ("serve.admission", {"state": "shed", "prev": "degrade",
+                         "queue_depth": 9, "inflight": 3}, True),
+    ("serve.admission", {"state": "admit", "prev": "shed",
+                         "queue_depth": 0, "inflight": 0}, False),
+    ("serve.session_frame", {"session": "s", "frame": 3, "age": 1,
+                             "drift": 0.0, "ok": False}, True),
+    ("serve.session_frame", {"session": "s", "frame": 3, "age": 1,
+                             "drift": 0.0, "ok": True}, False),
+    ("serve.shard_dead", {"shard": 1, "shards": 4, "failures": 2,
+                          "dropped": 3}, True),
+    ("train.guard_abort", {"gstep": 7, "skipped_steps": 3}, True),
+    ("train.step", {"gstep": 7, "step_ms": 80.0}, False),
+])
+def test_trigger_predicates(tmp_path, clean_telemetry, kind, fields, fires):
+    rec = _rec(tmp_path)
+    try:
+        rec.observe(kind, fields)
+        if fires:
+            assert _wait(lambda: rec.dumps == 1)
+        else:
+            assert not _wait(lambda: rec.dumps > 0, timeout=0.3)
+            assert rec.triggers == 0
+    finally:
+        rec.close()
+
+
+# ---------------- debounce / force / sigusr2 ----------------
+
+def test_breach_storm_collapses_to_one_bundle(tmp_path, clean_telemetry):
+    rec = _rec(tmp_path, debounce_s=120.0)
+    try:
+        for i in range(25):
+            rec.observe("serve.slo_breach",
+                        {"p99_ms": 90.0 + i, "objective_ms": 50.0,
+                         "window_s": 30.0})
+        assert _wait(lambda: rec.dumps == 1)
+        # every later trigger inside the window was suppressed, none queued
+        assert rec.triggers == 25
+        assert rec.suppressed == 24
+        time.sleep(0.2)  # give a buggy second dump a chance to appear
+        assert rec.dumps == 1 and len(_bundles(rec)) == 1
+        # an explicit non-forced trigger inside the window is debounced too
+        assert rec.trigger("operator", sync=True) is None
+        # force still lands
+        assert rec.trigger("operator", force=True, sync=True) is not None
+    finally:
+        rec.close()
+
+
+def test_sigusr2_forces_a_bundle(tmp_path, clean_telemetry):
+    rec = _rec(tmp_path, debounce_s=120.0)
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        rec.trigger("warmup", force=True, sync=True)  # opens the window
+        assert rec.install_sigusr2()
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert _wait(lambda: rec.dumps == 2)  # forced past the debounce
+        assert any("sigusr2" in n for n in _bundles(rec))
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+        rec.close()
+
+
+def test_keep_last_k_retention(tmp_path, clean_telemetry):
+    rec = _rec(tmp_path, keep=3)
+    try:
+        paths = [rec.trigger(f"r{i}", force=True, sync=True)
+                 for i in range(5)]
+    finally:
+        rec.close()
+    assert all(paths)
+    kept = _bundles(rec)
+    assert len(kept) == 3
+    # the newest three survive (same-second names get -N suffixes, which
+    # sort after the unsuffixed name — lexicographic == chronological)
+    assert [os.path.join(rec.out_dir, n) for n in kept] == paths[-3:]
+
+
+def test_dump_arms_profiler_request_once(tmp_path, clean_telemetry):
+    rec = _rec(tmp_path, arm_profile_steps=4)
+    try:
+        assert rec.take_profile_request() == 0
+        rec.trigger("x", force=True, sync=True)
+        assert rec.take_profile_request() == 4
+        assert rec.take_profile_request() == 0  # consumed
+    finally:
+        rec.close()
+
+
+def test_dump_failure_degrades_not_kills(tmp_path, clean_telemetry,
+                                         monkeypatch):
+    rec = _rec(tmp_path)
+    try:
+        def boom(*a, **k):
+            raise OSError("disk full")
+        monkeypatch.setattr(trecorder.tempfile, "mkdtemp", boom)
+        assert rec.trigger("doomed", force=True, sync=True) is None
+        assert rec.dump_failures == 1
+        monkeypatch.undo()
+        # the recorder is still alive and dumps once the disk recovers
+        assert rec.trigger("recovered", force=True, sync=True) is not None
+    finally:
+        rec.close()
+
+
+# ---------------- module state, obs.incident, /incidents ----------------
+
+def test_obs_incident_lands_on_sink_and_passes_strict(tmp_path,
+                                                      clean_telemetry):
+    stream = str(tmp_path / "events.jsonl")
+    tevents.configure(stream)
+    rec = trecorder.configure(str(tmp_path / "inc"), debounce_s=0.0)
+    try:
+        bundle = rec.trigger("pinned", force=True, sync=True)
+    finally:
+        trecorder.reset()
+        tevents.reset()
+    assert tevents.validate_file(stream, strict_kinds=True) == []
+    incidents = [e for e in tevents.read_events(stream)
+                 if e["kind"] == "obs.incident"]
+    assert len(incidents) == 1
+    assert incidents[0]["reason"] == "pinned"
+    assert incidents[0]["bundle"] == bundle
+
+
+def test_configure_replaces_and_release_clears_tee(tmp_path,
+                                                   clean_telemetry):
+    a = trecorder.configure(str(tmp_path / "a"))
+    b = trecorder.configure(str(tmp_path / "b"))  # replaces (and closes) a
+    assert trecorder.current_recorder() is b
+    assert not a._thread.is_alive()
+    # a stale owner releasing does not disturb the installed recorder
+    trecorder.release(a)
+    assert trecorder.current_recorder() is b
+    trecorder.release(b)
+    assert trecorder.current_recorder() is None
+    # tee gone: emits no longer reach b's ring
+    tevents.emit("serve.slo_breach", p99_ms=1.0, objective_ms=2.0,
+                 window_s=3.0)
+    assert b.triggers == 0
+
+
+def test_maybe_trigger_is_noop_without_recorder(clean_telemetry):
+    trecorder.maybe_trigger("nothing", gstep=1)  # must not raise
+    trecorder.record_stepline("line")
+
+
+def test_incidents_route_serves_list(tmp_path, clean_telemetry):
+    rec = _rec(tmp_path)
+    ops = OpsServer(port=0, incidents=rec.list_incidents).start()
+    try:
+        rec.trigger("routed", force=True, sync=True)
+        with urllib.request.urlopen(ops.url + "/incidents", timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["recorder"]["dumps"] == 1
+        assert len(body["incidents"]) == 1
+        assert body["incidents"][0]["reason"] == "routed"
+        assert body["incidents"][0]["bundle"].endswith("routed")
+    finally:
+        ops.close()
+        rec.close()
+
+
+# ---------------- EventSink size-capped rotation (satellite) ------------
+
+def test_event_sink_rotation_keeps_bounded_segments(tmp_path,
+                                                    clean_telemetry):
+    path = str(tmp_path / "ev.jsonl")
+    # ~1 KiB cap: each event is ~100 bytes, so a few dozen emits rotate
+    tevents.configure(path, max_mb=0.001, keep=2)
+    n = 120
+    for i in range(n):
+        tevents.emit("train.step", gstep=i, step_ms=80.0,
+                     pad="x" * 64)
+    sink = tevents.current_sink()
+    assert sink.rotations >= 2
+    tevents.reset()
+    segs = tevents.segment_paths(path)
+    # keep=2 rotated segments + the live file, no unbounded growth
+    assert segs == [path + ".2", path + ".1", path]
+    for seg in segs:
+        # the live path may be rotated out until the next emit reopens it
+        if seg == path and not os.path.exists(seg):
+            continue
+        assert os.path.getsize(seg) <= 2 * 1024  # cap + one record slack
+    # readers walk segments oldest-first: the tail of history is intact,
+    # in order, and strict-valid
+    events = tevents.read_events(path)
+    gsteps = [e["gstep"] for e in events]
+    assert gsteps == sorted(gsteps)
+    assert gsteps[-1] == n - 1
+    assert len(gsteps) >= 3  # at least the retained segments' worth
+    assert tevents.validate_file(path, strict_kinds=True) == []
+
+
+def test_event_sink_no_rotation_by_default(tmp_path, clean_telemetry):
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    for i in range(200):
+        tevents.emit("train.step", gstep=i, step_ms=80.0, pad="x" * 64)
+    tevents.reset()
+    assert tevents.segment_paths(path) == [path]
+    assert len(tevents.read_events(path)) == 200
+
+
+# ---------------- resource gauges sampler (satellite) -------------------
+
+def test_sample_once_publishes_process_gauges():
+    from mine_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    tresource.sample_once(registry=reg)
+    snap = reg.snapshot()
+    assert snap["process.rss_bytes"] > 1 << 20  # a python process is >1MiB
+    assert snap["process.threads"] >= 1
+    assert snap["process.open_fds"] >= 3
+    assert "process.gc_collections" in snap
+
+
+def test_resource_sampler_thread_lifecycle():
+    from mine_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    s = tresource.ResourceSampler(0.02, registry=reg)
+    assert s.active
+    assert _wait(lambda: reg.snapshot().get("process.rss_bytes", 0) > 0)
+    s.close()
+    assert not s.active
+    # interval <= 0: a disabled no-op, close() is safe
+    off = tresource.ResourceSampler(0.0, registry=reg)
+    assert not off.active
+    off.close()
+
+
+# ---------------- live fleet: breach -> bundle with trace ids -----------
+
+S, HW = 4, 8
+POSE = np.eye(4, dtype=np.float32)[None]
+
+
+def _tiny_mpi(seed):
+    rng = np.random.RandomState(seed)
+    p = rng.uniform(-1, 1, (S, 4, HW, HW)).astype(np.float32)
+    return (p[:, 0:3], p[:, 3:4],
+            np.linspace(1.0, 0.2, S, dtype=np.float32),
+            np.eye(3, dtype=np.float32))
+
+
+@pytest.mark.slow
+def test_live_fleet_slo_breach_bundle_has_breaching_trace_ids(
+        tmp_path, clean_telemetry):
+    """Real traffic through a real fleet: every request traced, a p99 far
+    over the objective trips the edge-triggered breach once the window
+    holds MIN_BREACH_SAMPLES, the tee captures a bundle, and the bundle's
+    own events tail carries the breaching requests' trace ids — the
+    postmortem can name the exact requests inside the bad window."""
+    from mine_tpu.serve import ServeFleet
+    from mine_tpu.telemetry.slo import MIN_BREACH_SAMPLES
+
+    tracing.configure(sample=1.0)
+    rec = trecorder.configure(str(tmp_path / "inc"), debounce_s=0.0,
+                              events_tail=512)
+    fleet = ServeFleet(cache_shards=2, max_requests=4, max_wait_ms=1.0,
+                       max_bucket=4, slo_objective_ms=0.001,
+                       ops_port=None, recorder=rec)
+    try:
+        for i in range(3):
+            fleet.engine.put(f"img{i}", *_tiny_mpi(i))
+        futs = [fleet.submit(f"img{i % 3}", POSE[0])
+                for i in range(MIN_BREACH_SAMPLES + 6)]
+        for f in futs:
+            f.result(timeout=120)
+        assert _wait(lambda: rec.dumps >= 1, timeout=20), \
+            "breach never produced a bundle"
+    finally:
+        fleet.close()
+        trecorder.reset()
+
+    bundle = os.path.join(rec.out_dir, _bundles(rec)[-1])
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["reason"] == "serve.slo_breach"
+    tail = tevents.read_events(os.path.join(bundle, "events.jsonl"))
+    tail_traces = {e["trace"] for e in tail
+                   if e.get("kind") == "trace.span" and e.get("trace")}
+    assert tail_traces, "no trace ids in the captured events tail"
+    with open(os.path.join(bundle, "traces.json")) as f:
+        ring_traces = {t["trace"] for t in json.load(f)["traces"]
+                       if t.get("trace")}
+    # the tail and the trace ring agree on who was in the bad window
+    assert tail_traces & ring_traces
+    # the SLO window and fleet state were captured mid-incident
+    with open(os.path.join(bundle, "slo.json")) as f:
+        slo = json.load(f)
+    assert slo["window_n"] >= MIN_BREACH_SAMPLES
+    with open(os.path.join(bundle, "state.json")) as f:
+        state = json.load(f)
+    assert "fleet" in state and "health" in state
+    assert postmortem.main([bundle]) == 0
+
+
+@pytest.mark.slow
+def test_serve_render_bitwise_identical_recorder_on_off(tmp_path,
+                                                        clean_telemetry):
+    """Arming the recorder (tee on every emit, providers registered) must
+    not perturb a single output byte — same engine, same pose, compared
+    before and after configure()."""
+    from mine_tpu.serve import RenderEngine
+
+    engine = RenderEngine(max_bucket=4)
+    engine.put("img", *_tiny_mpi(0))
+    rgb0, depth0 = engine.render("img", POSE)
+    rec = trecorder.configure(str(tmp_path / "inc"), debounce_s=0.0)
+    try:
+        rec.add_state_provider("noop", lambda: {})
+        rgb1, depth1 = engine.render("img", POSE)
+        rec.trigger("mid_serve", force=True, sync=True)
+        rgb2, depth2 = engine.render("img", POSE)
+    finally:
+        trecorder.reset()
+    np.testing.assert_array_equal(rgb0, rgb1)
+    np.testing.assert_array_equal(depth0, depth1)
+    np.testing.assert_array_equal(rgb0, rgb2)
+    np.testing.assert_array_equal(depth0, depth2)

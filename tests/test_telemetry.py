@@ -305,6 +305,7 @@ def test_log_training_emits_st1_line_and_registry(tmp_path, clean_sink):
                      for k in TIME_METER_KEYS},
         train_meters={},
         _step_hist=deque(maxlen=64),  # ops-plane state (PR 12)
+        recorder=None,  # flight recorder off (PR 15)
         _ops_state={"gstep": 0, "epoch": 0, "epochs": 0,
                     "guard_consecutive": 0.0, "data_errors": 0,
                     "data_errors_delta": 0},

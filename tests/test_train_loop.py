@@ -350,3 +350,63 @@ def test_train_epoch_grad_accum_runs(tmp_path):
     state = trainer.init_state(batch_size=1)
     state = loop.train_epoch(state, epoch=0)
     assert int(state.step) == 5
+
+
+@pytest.mark.slow
+def test_train_params_bitwise_identical_recorder_on_off(tmp_path,
+                                                        monkeypatch):
+    """The flight recorder is numerically free: a run with the recorder
+    armed (events tee live, st1/snapshot rings fed at log cadence, train
+    state provider registered) produces BITWISE-identical params to a run
+    without it. Each run gets its own trainer+loop inside a helper so the
+    trainer<->jitted-step cycle (which pins the checkpointer's orbax
+    executor threads) is collectable before the session thread-leak
+    tripwire looks — the same structure the observatory parity test uses."""
+    from mine_tpu.telemetry import events as tevents
+    from mine_tpu.telemetry import recorder as trecorder
+
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    trecorder.reset()
+    tevents.reset()
+
+    inc_dir = str(tmp_path / "incidents")
+
+    def run(ws, extra, expect_recorder):
+        cfg = tiny_config()
+        cfg.update({"training.log_interval": 1})
+        cfg.update(extra)
+        data = SyntheticLoaderAdapter()
+        trainer = SynthesisTrainer(cfg, steps_per_epoch=max(1, len(data)))
+        loop = TrainLoop(trainer, data, None, str(tmp_path / ws),
+                         logger=None, tb_writer=None)
+        assert (loop.recorder is not None) == expect_recorder
+        try:
+            state = loop.run(epochs=1)
+            if expect_recorder:
+                # run() released the recorder on the way out (tee gone)
+                assert loop.recorder is None
+                assert trecorder.current_recorder() is None
+        finally:
+            trecorder.reset()
+            tevents.reset()
+        return state
+
+    plain = run("plain", {}, expect_recorder=False)
+    armed = run("armed", {
+        "telemetry.enabled": True,
+        "telemetry.events_path": str(tmp_path / "events.jsonl"),
+        "telemetry.recorder.enabled": True,
+        "telemetry.recorder.dir": inc_dir,
+        "telemetry.recorder.debounce_s": 1.0,
+    }, expect_recorder=True)
+
+    import jax
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(plain.params),
+                              jax.tree_util.tree_leaves(armed.params)):
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+    # a clean run captures nothing — the black box is rings, not bundles
+    assert not os.path.isdir(inc_dir) or os.listdir(inc_dir) == []
+
+    import gc
+    gc.collect()

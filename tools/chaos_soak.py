@@ -23,6 +23,13 @@ against a clean reference — the host-side sibling of the on-TPU soak:
 
 Faults come from --faults JSON or the MINE_TPU_FAULTS env var (env wins;
 see mine_tpu/testing/faults.py for the keys).
+
+Every leg runs with telemetry and the flight recorder armed (recorder-on
+is test-pinned bitwise identical to recorder-off, so the parity check is
+unaffected): a guard abort or preemption inside a leg captures a live
+bundle under <leg_ws>/incidents, and a stitched-trace DIVERGENCE makes
+the parent assemble an offline bundle from the dead leg's event stream —
+render either with `python tools/postmortem.py BUNDLE_DIR`.
 """
 
 import argparse
@@ -128,9 +135,50 @@ def read_trace(path):
 
 
 def _leg_cmd(workspace, steps_file, epochs, num_views):
+    # every leg runs with telemetry + the flight recorder armed: a killed
+    # leg leaves its event stream and any incident bundles in `workspace`
+    # for the parent's postmortem, and recorder-on is test-pinned bitwise
+    # identical to recorder-off so the soak's own parity check still holds
+    overrides = {
+        "telemetry.enabled": True,
+        "telemetry.events_path": os.path.join(workspace, "events.jsonl"),
+        "telemetry.recorder.enabled": True,
+        "telemetry.recorder.dir": os.path.join(workspace, "incidents"),
+        "telemetry.recorder.debounce_s": 1.0,
+    }
     return [sys.executable, os.path.abspath(__file__), "run",
             "--workspace", workspace, "--steps-file", steps_file,
-            "--epochs", str(epochs), "--num-views", str(num_views)]
+            "--epochs", str(epochs), "--num-views", str(num_views),
+            "--config-overrides", json.dumps(overrides)]
+
+
+def _divergence_bundle(base, ref, chaos, bad, cycles):
+    """Assemble an OFFLINE incident bundle from a diverged soak: preload
+    the chaos leg's on-disk event stream into a fresh recorder's ring and
+    force one dump with the divergence as the trigger. Best-effort — a
+    bundling failure must not mask the nonzero exit."""
+    try:
+        from mine_tpu.telemetry import events as tevents
+        from mine_tpu.telemetry import recorder as trecorder
+        rec = trecorder.FlightRecorder(
+            os.path.join(base, "incidents"), events_tail=512,
+            debounce_s=0.0, keep=8)
+        try:
+            leg_events = os.path.join(base, "chaos_ws", "events.jsonl")
+            for e in tevents.read_events(leg_events)[-512:]:
+                rec.observe_event(e)
+            sample = {str(s): {"chaos": c, "ref": r}
+                      for s, (c, r) in list(bad.items())[:10]}
+            return rec.trigger(
+                "train_soak_divergence", force=True, sync=True,
+                ref_steps=len(ref), chaos_steps=len(chaos),
+                mismatched=len(bad), cycles=cycles,
+                sample=json.dumps(sample, sort_keys=True))
+        finally:
+            rec.close()
+    except Exception as e:  # noqa: BLE001
+        print("divergence bundling failed: %s" % e, file=sys.stderr)
+        return None
 
 
 def cmd_soak(args):
@@ -170,6 +218,10 @@ def cmd_soak(args):
     bad = {s: (chaos.get(s), ref[s]) for s in ref if chaos.get(s) != ref[s]}
     if bad or len(chaos) != len(ref):
         print("DIVERGENCE after kill/resume:", dict(list(bad.items())[:5]))
+        bundle = _divergence_bundle(base, ref, chaos, bad, cycles)
+        if bundle:
+            print("incident bundle: %s (render: python tools/postmortem.py"
+                  " %s)" % (bundle, bundle))
         return 1
     print("soak OK: %d steps bitwise-identical across %d kill/resume cycles"
           % (len(ref), cycles - 1))

@@ -24,6 +24,8 @@ so explicitly instead of printing empty serve tables.
 Usage:
   python tools/obs_report.py EVENTS.jsonl [--log TRAIN.log ...]
   python tools/obs_report.py EVENTS.jsonl --validate   # schema check only
+  python tools/obs_report.py EVENTS.jsonl --json       # stable mtpu-obs1
+                                                       # dict for dashboards
 
 --validate exits nonzero when any line violates the mtpu-ev1 schema —
 tools/verify_tier1.sh runs this over the event stream the test suite emits.
@@ -294,6 +296,14 @@ def report(events, log_lines):
                           e.get("window_s"), e.get("window_n"),
                           e.get("error_budget_burn")))
 
+    incidents = [e for e in events if e.get("kind") == "obs.incident"]
+    if incidents:
+        out.append("")
+        out.append("incident bundles captured (%d — "
+                   "render with tools/postmortem.py):" % len(incidents))
+        for e in incidents:
+            out.append("  [%s] %s" % (e.get("reason"), e.get("bundle")))
+
     traces, incomplete = _group_traces(events)
     if traces or incomplete:
         out.append("")
@@ -347,6 +357,60 @@ def report(events, log_lines):
     return "\n".join(out)
 
 
+def _stat_dict(vals):
+    return {"count": len(vals), "mean": sum(vals) / len(vals),
+            "p50": _pct(vals, 0.5), "p90": _pct(vals, 0.9),
+            "p99": _pct(vals, 0.99)}
+
+
+def report_json(events, log_lines):
+    """The machine face of report(): a stable dict for dashboards and CI
+    assertions. Keys are append-only — consumers pin what they read."""
+    out = {"schema": "mtpu-obs1",
+           "totals": dict(TallyCounter(e.get("kind", "?") for e in events)),
+           "events": len(events)}
+
+    spans = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "span" and isinstance(e.get("ms"), (int, float)):
+            spans[e.get("name", "?")].append(float(e["ms"]))
+    out["spans"] = {name: _stat_dict(vals)
+                    for name, vals in sorted(spans.items())}
+
+    steps = defaultdict(list)
+    for e in events:
+        if e.get("kind") == "train.step":
+            for k in stepline.STEP_KEYS[:-1]:
+                if isinstance(e.get(k), (int, float)):
+                    steps[k].append(float(e[k]))
+    for line in log_lines:
+        rec = stepline.parse_line(line)
+        if rec:
+            for k in stepline.TIME_KEYS:
+                steps[k + "_ms"].append(rec[k])
+    out["step_time"] = {k: _stat_dict(v)
+                        for k, v in sorted(steps.items()) if v}
+
+    out["bucket_compiles"] = [
+        {"entries_bucket": e.get("entries_bucket"),
+         "poses_bucket": e.get("poses_bucket"),
+         "warp_impl": e.get("warp_impl"), "dtype": e.get("dtype"),
+         "compile_ms": float(e.get("compile_ms", 0.0)),
+         "store_hit": bool(e.get("store_hit"))}
+        for e in events if e.get("kind") == "serve.bucket_compile"]
+
+    out["slo_breaches"] = [
+        {k: e.get(k) for k in ("ts", "p99_ms", "objective_ms", "window_s",
+                               "window_n", "error_budget_burn")}
+        for e in events if e.get("kind") == "serve.slo_breach"]
+
+    out["incidents"] = [
+        {"ts": e.get("ts"), "reason": e.get("reason"),
+         "bundle": e.get("bundle")}
+        for e in events if e.get("kind") == "obs.incident"]
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Summarize a mine_tpu telemetry event stream")
@@ -355,6 +419,10 @@ def main(argv=None):
                         help="training log(s) to fold step-time lines from")
     parser.add_argument("--validate", action="store_true",
                         help="schema-check only; exit 1 on any invalid line")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the stable mtpu-obs1 JSON report instead "
+                             "of text (totals, span/step stats, compile "
+                             "history, SLO breaches, incident bundles)")
     args = parser.parse_args(argv)
 
     if args.validate:
@@ -371,7 +439,12 @@ def main(argv=None):
     for p in args.log:
         with open(p) as f:
             log_lines.extend(f.readlines())
-    print(report(events, log_lines))
+    if args.json:
+        json.dump(report_json(events, log_lines), sys.stdout,
+                  indent=2, sort_keys=True)
+        print()
+    else:
+        print(report(events, log_lines))
     return 0
 
 

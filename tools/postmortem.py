@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""One-command postmortem over a flight-recorder incident bundle.
+
+A bundle (mine_tpu/telemetry/recorder.py, schema mtpu-inc1) is the black
+box a production incident leaves behind: the event tail leading up to the
+trigger, rolling metric snapshots, recent traces, the SLO window, the
+registered state providers, config + environment. This tool turns one
+bundle directory into a causal timeline a human reads top to bottom:
+
+  * validation first — every mtpu-inc1 file present, manifest schema
+    pinned, events strict against mtpu-ev1, every JSON artifact parseable,
+    metrics.prom well-formed. A malformed bundle exits NONZERO before any
+    rendering (verify_tier1.sh gates on this via --selftest);
+  * the trigger (reason + the exact event/context that fired it);
+  * the event timeline, delta-stamped against the trigger instant, with
+    the watched trigger kinds flagged;
+  * admission/shard state transitions pulled out of the tail;
+  * the SLO window at dump time;
+  * metric deltas: final values vs the OLDEST rolling snapshot (the
+    pre-incident baseline) — what moved while things went wrong;
+  * per-trace waterfalls of the slowest captured traces (rendering reuses
+    obs_report's shared helpers, same bars, same parser);
+  * the last st1 step lines (train-plane bundles).
+
+Usage:
+  python tools/postmortem.py INCIDENT_DIR          # render (rc 0/2)
+  python tools/postmortem.py --selftest            # synthesize + verify
+
+--selftest builds a synthetic bundle through the real FlightRecorder dump
+path, asserts it renders, then asserts a corrupted copy is REJECTED —
+the one-command gate that the capture and the reader agree on the format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+from mine_tpu.telemetry import events as tevents  # noqa: E402
+from mine_tpu.telemetry import recorder as trecorder  # noqa: E402
+import obs_report  # noqa: E402  (shared waterfall/percentile helpers)
+
+TIMELINE_LIMIT = 80      # newest events rendered in the timeline
+TRACE_LIMIT = 3          # slowest trace waterfalls
+DELTA_LIMIT = 12         # biggest metric movements
+
+
+# ------------------------------------------------------------- validation
+
+def _load_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate_bundle(bundle: str):
+    """-> (errors, manifest|None). Empty errors == renderable bundle."""
+    errors = []
+    if not os.path.isdir(bundle):
+        return [f"not a directory: {bundle}"], None
+    for name in trecorder.BUNDLE_FILES:
+        if not os.path.isfile(os.path.join(bundle, name)):
+            errors.append(f"missing bundle file: {name}")
+    if errors:
+        return errors, None
+
+    manifest = None
+    try:
+        manifest = _load_json(os.path.join(bundle, "manifest.json"))
+    except Exception as e:
+        errors.append(f"manifest.json unreadable: {e}")
+    if manifest is not None:
+        if manifest.get("schema") != trecorder.BUNDLE_SCHEMA:
+            errors.append(
+                "manifest schema %r (expected %r)"
+                % (manifest.get("schema"), trecorder.BUNDLE_SCHEMA))
+        for field in ("reason", "ts", "bundle"):
+            if field not in manifest:
+                errors.append(f"manifest.json missing field {field!r}")
+
+    # the events tail must be a clean mtpu-ev1 stream, strict mode: a
+    # bundle whose own capture drifted from the documented schemas is a
+    # recorder bug, not something to render around
+    errors.extend(
+        "events.jsonl " + e
+        for e in tevents.validate_file(os.path.join(bundle, "events.jsonl"),
+                                       strict_kinds=True))
+
+    for name in ("traces.json", "slo.json", "state.json", "metrics.json",
+                 "config.json", "environment.json"):
+        try:
+            _load_json(os.path.join(bundle, name))
+        except Exception as e:
+            errors.append(f"{name} unreadable: {e}")
+
+    try:
+        with open(os.path.join(bundle, "snapshots.jsonl")) as f:
+            for i, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                snap = json.loads(line)
+                if not isinstance(snap, dict) or "metrics" not in snap:
+                    errors.append(
+                        f"snapshots.jsonl line {i}: not a snapshot object")
+    except Exception as e:
+        errors.append(f"snapshots.jsonl unreadable: {e}")
+
+    try:
+        with open(os.path.join(bundle, "metrics.prom")) as f:
+            for i, line in enumerate(f, 1):
+                s = line.strip()
+                if not s or s.startswith("#"):
+                    continue
+                parts = s.rsplit(None, 1)
+                if len(parts) != 2:
+                    errors.append(f"metrics.prom line {i}: not 'name value'")
+                    continue
+                try:
+                    float(parts[1])
+                except ValueError:
+                    errors.append(
+                        f"metrics.prom line {i}: non-numeric value "
+                        f"{parts[1]!r}")
+    except Exception as e:
+        errors.append(f"metrics.prom unreadable: {e}")
+
+    return errors, manifest
+
+
+# --------------------------------------------------------------- rendering
+
+def _fmt_fields(e, skip=("schema", "ts", "kind"), limit=6):
+    items = [(k, v) for k, v in e.items() if k not in skip]
+    shown = ["%s=%s" % (k, json.dumps(v, default=str)
+                        if isinstance(v, (dict, list)) else v)
+             for k, v in items[:limit]]
+    if len(items) > limit:
+        shown.append("+%d more" % (len(items) - limit))
+    return " ".join(str(s) for s in shown)
+
+
+def _scalar_metrics(metrics):
+    """Flatten a registry snapshot to name -> float: counters/gauges as-is,
+    histograms by their count (movement = new recordings)."""
+    out = {}
+    for name, v in (metrics or {}).items():
+        if isinstance(v, (int, float)):
+            out[name] = float(v)
+        elif isinstance(v, dict) and isinstance(v.get("count"),
+                                                (int, float)):
+            out[name + ".count"] = float(v["count"])
+    return out
+
+
+def render(bundle: str, manifest) -> str:
+    events = tevents.read_events(os.path.join(bundle, "events.jsonl"))
+    slo = _load_json(os.path.join(bundle, "slo.json"))
+    state = _load_json(os.path.join(bundle, "state.json"))
+    metrics = _load_json(os.path.join(bundle, "metrics.json"))
+    env = _load_json(os.path.join(bundle, "environment.json"))
+    snapshots = []
+    with open(os.path.join(bundle, "snapshots.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                snapshots.append(json.loads(line))
+    with open(os.path.join(bundle, "steplines.txt")) as f:
+        steplines = [ln.rstrip("\n") for ln in f if ln.strip()]
+
+    t0 = float(manifest.get("ts", 0.0))
+    out = []
+    out.append("incident bundle: %s" % manifest.get("bundle"))
+    out.append("  reason:      %s" % manifest.get("reason"))
+    out.append("  at:          %s UTC"
+               % time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(t0)))
+    out.append("  config_hash: %s" % manifest.get("config_hash"))
+    counts = manifest.get("counts") or {}
+    out.append("  captured:    %s" % " ".join(
+        "%s=%s" % (k, counts[k]) for k in sorted(counts)))
+    if isinstance(env, dict) and env:
+        keys = [k for k in ("schema", "jax", "backend", "devices", "error")
+                if k in env]
+        out.append("  environment: %s" % " ".join(
+            "%s=%s" % (k, env[k]) for k in keys))
+
+    trig = manifest.get("trigger")
+    if trig:
+        out.append("")
+        out.append("trigger:")
+        out.append("  %s" % _fmt_fields(trig, skip=("schema",), limit=10))
+
+    if events:
+        out.append("")
+        shown = events[-TIMELINE_LIMIT:]
+        dropped = len(events) - len(shown)
+        out.append("timeline (%d events%s; dt vs trigger):"
+                   % (len(events),
+                      ", oldest %d elided" % dropped if dropped else ""))
+        for e in shown:
+            dt = float(e.get("ts", t0)) - t0
+            mark = ">>" if e.get("kind") in trecorder.TRIGGER_KINDS else "  "
+            out.append("  %s %+9.3fs %-24s %s"
+                       % (mark, dt, e.get("kind", "?"),
+                          _fmt_fields(e)))
+
+    transitions = [e for e in events
+                   if e.get("kind") in ("serve.admission", "serve.shard_dead",
+                                        "serve.shard_revive")]
+    if transitions:
+        out.append("")
+        out.append("admission/fleet transitions:")
+        for e in transitions:
+            out.append("  %+9.3fs %-20s %s"
+                       % (float(e.get("ts", t0)) - t0, e.get("kind"),
+                          _fmt_fields(e)))
+
+    if isinstance(slo, dict) and slo:
+        out.append("")
+        out.append("slo window at dump:")
+        for k in sorted(slo):
+            out.append("  %-20s %s" % (k, slo[k]))
+
+    if isinstance(state, dict) and state:
+        out.append("")
+        out.append("state providers:")
+        for name in sorted(state):
+            v = state[name]
+            body = (_fmt_fields(v, skip=(), limit=8)
+                    if isinstance(v, dict) else str(v))
+            out.append("  %-12s %s" % (name, body))
+
+    # metric movement: final values against the OLDEST rolling snapshot —
+    # the most pre-incident baseline the ring still holds
+    if snapshots:
+        base = _scalar_metrics(snapshots[0].get("metrics"))
+        final = _scalar_metrics(metrics)
+        deltas = sorted(((abs(final[n] - base[n]), n,
+                          base[n], final[n])
+                         for n in final if n in base
+                         and final[n] != base[n]), reverse=True)
+        if deltas:
+            out.append("")
+            out.append("metric movement since oldest snapshot (%+.0fs):"
+                       % (float(snapshots[0].get("ts", t0)) - t0))
+            for _, n, b, v in deltas[:DELTA_LIMIT]:
+                out.append("  %-44s %12g -> %-12g (%+g)" % (n, b, v, v - b))
+            if len(deltas) > DELTA_LIMIT:
+                out.append("  ... %d more changed" %
+                           (len(deltas) - DELTA_LIMIT))
+
+    complete, incomplete = obs_report._group_traces(events)
+    if complete:
+        out.append("")
+        slowest = sorted(complete,
+                         key=lambda t: -float(t["root"].get("ms", 0.0)))
+        out.append("slowest captured traces (%d complete%s):"
+                   % (len(complete),
+                      ", %d incomplete" % incomplete if incomplete else ""))
+        for t in slowest[:TRACE_LIMIT]:
+            root = t["root"]
+            out.append("  trace %s  %s  %.2f ms"
+                       % (root.get("trace"), root.get("name", "?"),
+                          float(root.get("ms", 0.0))))
+            for span in t["children"]:
+                out.append(obs_report._waterfall_row(
+                    span, float(root.get("ms", 0.0))))
+
+    if steplines:
+        out.append("")
+        out.append("last st1 step lines:")
+        for ln in steplines[-8:]:
+            out.append("  " + ln)
+
+    out.append("")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------- selftest
+
+def _selftest() -> int:
+    """Build a synthetic bundle through the real dump path, assert it
+    renders, then assert a corrupted copy is rejected."""
+    tmp = tempfile.mkdtemp(prefix="mtpu-postmortem-selftest-")
+    try:
+        rec = trecorder.FlightRecorder(
+            os.path.join(tmp, "incidents"), events_tail=32,
+            debounce_s=0.0, keep=3, config={"training": {"seed": 7}})
+        try:
+            rec.set_slo(None)
+            rec.add_state_provider("fleet", lambda: {"shards": 2,
+                                                     "dead": [1]})
+            now = time.time()
+            for i in range(6):
+                rec.observe("serve.render", {"image_id": "img%d" % i,
+                                             "ms": 4.0 + i})
+            rec.observe_stepline(
+                "st1 step=12 step_ms=81.0 data_ms=2.0 h2d_ms=1.0 "
+                "host_ms=3.0 data_errors=0")
+            rec.snapshot_metrics(scope="selftest")
+            rec.observe_event({"schema": tevents.SCHEMA, "ts": now,
+                               "kind": "serve.slo_breach", "p99_ms": 91.0,
+                               "objective_ms": 50.0, "window_s": 30.0})
+            bundle = rec.trigger("selftest_breach", force=True, sync=True,
+                                 p99_ms=91.0)
+        finally:
+            rec.close()
+        if not bundle:
+            print("selftest: dump returned no bundle", file=sys.stderr)
+            return 1
+        errors, manifest = validate_bundle(bundle)
+        if errors:
+            print("selftest: fresh bundle failed validation:",
+                  file=sys.stderr)
+            for e in errors:
+                print("  " + e, file=sys.stderr)
+            return 1
+        text = render(bundle, manifest)
+        for needle in ("selftest_breach", "serve.slo_breach",
+                       "state providers", "st1 step=12"):
+            if needle not in text:
+                print("selftest: render missing %r" % needle,
+                      file=sys.stderr)
+                return 1
+
+        # corruption must be LOUD: missing file, bad manifest, bad events
+        broken = os.path.join(tmp, "broken-missing")
+        shutil.copytree(bundle, broken)
+        os.remove(os.path.join(broken, "slo.json"))
+        if not validate_bundle(broken)[0]:
+            print("selftest: missing-file bundle passed", file=sys.stderr)
+            return 1
+        broken2 = os.path.join(tmp, "broken-manifest")
+        shutil.copytree(bundle, broken2)
+        with open(os.path.join(broken2, "manifest.json"), "w") as f:
+            f.write("{not json")
+        if not validate_bundle(broken2)[0]:
+            print("selftest: bad-manifest bundle passed", file=sys.stderr)
+            return 1
+        broken3 = os.path.join(tmp, "broken-events")
+        shutil.copytree(bundle, broken3)
+        with open(os.path.join(broken3, "events.jsonl"), "a") as f:
+            f.write('{"schema": "mtpu-ev1", "ts": 1.0, '
+                    '"kind": "obs.incident"}\n')  # strict: missing fields
+        if not validate_bundle(broken3)[0]:
+            print("selftest: strict-invalid events passed", file=sys.stderr)
+            return 1
+        print("postmortem selftest: OK (bundle %s)"
+              % os.path.basename(bundle))
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Render a causal postmortem from one incident bundle")
+    parser.add_argument("bundle", nargs="?",
+                        help="incident bundle directory (mtpu-inc1)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="synthesize a bundle, assert render + "
+                             "corruption rejection")
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+    if not args.bundle:
+        parser.error("bundle directory required (or --selftest)")
+
+    errors, manifest = validate_bundle(args.bundle)
+    if errors:
+        print("%s: MALFORMED bundle (%d error(s))"
+              % (args.bundle, len(errors)), file=sys.stderr)
+        for e in errors:
+            print("  " + e, file=sys.stderr)
+        return 2
+    print(render(args.bundle, manifest))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

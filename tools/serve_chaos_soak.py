@@ -34,7 +34,12 @@ exits NONZERO if any invariant breaks:
     or any failed request;
   * the session phase drops a frame, fails to re-encode after the owner
     kill, or ends with the session table non-empty;
-  * the funneled event stream fails mtpu-ev1 strict validation.
+  * the funneled event stream fails mtpu-ev1 strict validation;
+  * the flight recorder (armed for the whole soak) captured no incident
+    bundle — the admission shed and shard kill are watched trigger kinds,
+    so a clean run MUST leave bundles behind — or any captured bundle
+    fails to render through tools/postmortem.py. A violation additionally
+    force-dumps a bundle carrying the failing invariant as its trigger.
 
 Usage (CPU is fine — the point is the control plane, not render speed):
 
@@ -107,6 +112,9 @@ def main():
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--events", type=str, default=None,
                     help="event-stream path (default: a temp file)")
+    ap.add_argument("--incidents-dir", type=str, default=None,
+                    help="flight-recorder bundle directory (default: "
+                         "incidents/ next to the event stream)")
     args = ap.parse_args()
 
     import jax
@@ -117,6 +125,7 @@ def main():
     from mine_tpu.serve.admission import (TIER_BEST_EFFORT, TIER_CRITICAL,
                                           TIER_STANDARD)
     from mine_tpu.telemetry import events as tevents
+    from mine_tpu.telemetry import recorder as trecorder
     from mine_tpu.testing import faults
     from mine_tpu.testing.faults import FaultPlan
 
@@ -124,6 +133,17 @@ def main():
         tempfile.mkdtemp(prefix="serve_soak_"), "events.jsonl")
     tevents.reset()
     tevents.configure(events_path)
+    # flight recorder armed for the whole soak: the admission ladder
+    # reaching shed and the shard kill are watched trigger kinds, so the
+    # GREEN path must produce bundles too — and any violation force-dumps
+    # one with the failing invariant in its trigger context
+    incidents_dir = args.incidents_dir or os.path.join(
+        os.path.dirname(os.path.abspath(events_path)), "incidents")
+    rec = trecorder.configure(incidents_dir, debounce_s=1.0, keep=16,
+                              config={"soak": "serve_chaos",
+                                      "flood": args.flood,
+                                      "shards": args.shards})
+    live = {"rec": rec}  # cleared once the recorder is released
 
     violations = []
 
@@ -131,6 +151,10 @@ def main():
         if not cond:
             violations.append(msg)
             print(f"phase=check VIOLATION {msg}", flush=True)
+            if live["rec"] is not None:
+                bundle = live["rec"].trigger(
+                    "serve_soak_violation", force=True, sync=True, msg=msg)
+                print(f"phase=check incident_bundle={bundle}", flush=True)
 
     fleet = ServeFleet(
         cache_shards=args.shards, max_requests=8, max_wait_ms=2.0,
@@ -139,7 +163,7 @@ def main():
         encode_backoff_ms=5.0, shard_fail_threshold=2,
         admission_enabled=True, admission_burn_max=0.0,
         admission_queue_high=8, admission_inflight_high=0,
-        admission_shed_factor=2.0)
+        admission_shed_factor=2.0, recorder=rec)
     try:
         # ---- phase: warm ----
         keys = [_key(i % args.shards, args.shards, f"warm{i}")
@@ -268,6 +292,10 @@ def main():
     finally:
         faults.set_plan(None)
         fleet.close()
+        # release BEFORE the sink closes: the worker drains pending dumps
+        # on close, and their obs.incident events must land on disk
+        live["rec"] = None
+        trecorder.release(rec)
         tevents.reset()  # close the sink: every line on disk for validation
 
     problems = tevents.validate_file(events_path, strict_kinds=True)
@@ -275,8 +303,28 @@ def main():
     kinds = {e["kind"] for e in tevents.read_events(events_path)}
     for want in ("serve.admission", "serve.shard_dead", "serve.shard_revive",
                  "serve.session_start", "serve.session_keyframe",
-                 "serve.session_frame", "serve.session_end"):
+                 "serve.session_frame", "serve.session_end", "obs.incident"):
         check(want in kinds, f"expected a {want} event in the stream")
+
+    # the black box must have caught the soak's own chaos (admission shed
+    # and the shard kill are watched kinds), and every bundle must be a
+    # complete, postmortem-renderable capture — the end-to-end proof that
+    # an on-call human gets a readable story out of this fleet
+    listing = rec.list_incidents()
+    check(listing["incidents"],
+          f"no incident bundles captured in {incidents_dir}")
+    import subprocess
+    for inc in listing["incidents"]:
+        pm = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+             inc["path"]], capture_output=True, text=True)
+        check(pm.returncode == 0,
+              f"postmortem failed on {inc['path']} (rc={pm.returncode}): "
+              f"{pm.stderr.strip()[:400]}")
+    print(f"phase=incidents bundles={len(listing['incidents'])} "
+          f"triggers={listing['recorder']['triggers']} "
+          f"suppressed={listing['recorder']['suppressed']} "
+          f"dir={incidents_dir}", flush=True)
 
     if violations:
         print(f"phase=done SOAK FAIL violations={len(violations)}",

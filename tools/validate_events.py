@@ -3,8 +3,10 @@
 
 Every line of every given file must satisfy mine_tpu.telemetry.events'
 schema (valid JSON object, schema/ts/kind fields, known schema tag); blank
-lines are tolerated. Exit 0 when clean, 1 with per-line errors on stderr
-otherwise. tools/verify_tier1.sh runs this over the event stream the test
+lines are tolerated. Size-capped streams (telemetry.events_max_mb) are
+validated across ALL rotated segments (`path.K` ... `path.1`, then the
+live file), oldest-first. Exit 0 when clean, 1 with per-line errors on
+stderr otherwise. tools/verify_tier1.sh runs this over the event stream the test
 suite emits via MINE_TPU_TELEMETRY_EVENTS, so a subsystem that starts
 writing malformed events fails tier-1 loudly instead of silently producing
 an unparseable stream.
@@ -28,7 +30,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from mine_tpu.telemetry.events import validate_file  # noqa: E402
+from mine_tpu.telemetry.events import (  # noqa: E402
+    segment_paths, validate_file)
 
 
 def main(argv=None) -> int:
@@ -44,7 +47,9 @@ def main(argv=None) -> int:
 
     failed = False
     for path in args.files:
-        if not os.path.exists(path):
+        # a just-rotated stream may have only `path.1` on disk until the
+        # next emit reopens the live file — that still counts as existing
+        if not any(os.path.exists(p) for p in segment_paths(path)):
             if args.allow_missing:
                 print("%s: missing (allowed)" % path)
                 continue

@@ -76,6 +76,19 @@ if ! timeout -k 10 600 python tools/audit.py --gate \
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# the incident-bundle capture/read contract is tier-1: postmortem's
+# selftest pushes a synthetic incident through the REAL FlightRecorder
+# dump path, renders it, and asserts a corrupted copy is rejected — so a
+# bundle-format drift between recorder.py and tools/postmortem.py fails
+# here, not during an actual incident
+if ! timeout -k 10 120 python tools/postmortem.py --selftest \
+        > /tmp/_t1_postmortem.txt 2>&1; then
+    tail -20 /tmp/_t1_postmortem.txt
+    echo "POSTMORTEM: tools/postmortem.py --selftest failed (output in" \
+         "/tmp/_t1_postmortem.txt)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # every checked-in bench JSON — the historical driver wrappers and any
 # conductor-written mtpu-bench1 round — must stay parseable by
 # tools/bench_conductor.py, which diffs future sweeps against them

@@ -404,9 +404,12 @@ def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False, extra=None):
 
 
 # the warppass sub-sweep order: gather reference first, then the banded
-# family in FLOP order; the separable XLA row is the JSON headline
+# family in FLOP order, then the render megakernel (renderpass_*: one
+# fused warp+dequant+composite program; warppass_*: its warp-only
+# contract, identical to pallas_diff). The separable XLA row stays the
+# JSON headline.
 WARPPASS_BACKENDS = ("xla", "xla_banded", "pallas_diff", "separable",
-                     "pallas_sep")
+                     "pallas_sep", "pallas_fused")
 
 
 def _measure_warppass(name, steps=MEASURE_STEPS, keep_run=False):

@@ -109,13 +109,17 @@ class MPIConfig:
     # platform); the shipped YAML default is "auto", resolved by
     # mpi_config_from_dict to pallas_diff on TPU / xla elsewhere
     composite_backend: str = "xla"
-    # "xla" | "xla_banded" | "pallas_diff" | "separable" | "pallas_sep":
-    # training-path homography warp ("xla_banded" = banded one-hot-matmul
-    # in pure XLA, ops/warp_banded.py; "pallas_diff" = banded MXU kernel
-    # fwd+bwd, kernels/warp_vjp.py; "separable" = row-then-column 1D
-    # one-hot matmuls in pure XLA, ops/warp_separable.py; "pallas_sep" =
-    # Pallas fwd+bwd pair of the separable form, kernels/warp_sep.py; all
-    # four carry a runtime gather fallback for out-of-domain poses)
+    # "xla" | "xla_banded" | "pallas_diff" | "separable" | "pallas_sep" |
+    # "pallas_fused": training-path homography warp ("xla_banded" = banded
+    # one-hot-matmul in pure XLA, ops/warp_banded.py; "pallas_diff" =
+    # banded MXU kernel fwd+bwd, kernels/warp_vjp.py; "separable" =
+    # row-then-column 1D one-hot matmuls in pure XLA,
+    # ops/warp_separable.py; "pallas_sep" = Pallas fwd+bwd pair of the
+    # separable form, kernels/warp_sep.py; "pallas_fused" = the
+    # warp+dequant+composite render megakernel, kernels/render_fused.py —
+    # in the render path it replaces the composite backend too; all five
+    # guarded backends carry a runtime gather fallback for out-of-domain
+    # poses)
     warp_backend: str = "xla"
     # fwd AND bwd band: since the round-4 transposed-splat backward the
     # Pallas VJP mirrors the forward's band placement, so one knob covers
@@ -300,6 +304,13 @@ class ServeConfig:
     # serve.session.keyframe_tier: priority of keyframe encodes (default
     # critical — under admission pressure interpolation sheds first)
     session_keyframe_tier: int = 2
+    # serve.warp_backend: warp/render backend of the serving engine (same
+    # value space as training.warp_backend minus "auto"); "pallas_fused"
+    # selects the one-pass render megakernel (kernels/render_fused.py) —
+    # the engine skips the pre-dequant and the kernel reads the quantized
+    # cache directly. "xla" (default) is byte-identical to the
+    # pre-megakernel engine.
+    warp_backend: str = "xla"
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -342,6 +353,7 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         session_drift_mode=str(g("serve.session.drift_mode", "probe")),
         session_probe_stride=int(g("serve.session.probe_stride", 4)),
         session_keyframe_tier=int(g("serve.session.keyframe_tier", 2)),
+        warp_backend=str(g("serve.warp_backend", "xla")),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -376,6 +388,11 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.scheduler must be continuous|micro, "
             f"got {out.scheduler!r}")
+    if out.warp_backend not in ("xla", "xla_banded", "pallas_diff",
+                                "separable", "pallas_sep", "pallas_fused"):
+        raise ValueError(
+            f"serve.warp_backend must be xla|xla_banded|pallas_diff|"
+            f"separable|pallas_sep|pallas_fused, got {out.warp_backend!r}")
     if not 0 <= out.ops_port <= 65535:
         raise ValueError(
             f"serve.ops_port must be in [0, 65535], got {out.ops_port}")
@@ -614,10 +631,10 @@ def mpi_config_from_dict(config: Dict[str, Any]) -> MPIConfig:
             f"plane_scan, got {backend!r}")
     warp_backend = _resolve_auto_backend(g("training.warp_backend", "auto"))
     if warp_backend not in ("xla", "xla_banded", "pallas_diff",
-                            "separable", "pallas_sep"):
+                            "separable", "pallas_sep", "pallas_fused"):
         raise ValueError(
             f"training.warp_backend must be auto|xla|xla_banded|pallas_diff|"
-            f"separable|pallas_sep, got {warp_backend!r}")
+            f"separable|pallas_sep|pallas_fused, got {warp_backend!r}")
     warp_sep_tol = float(g("training.warp_sep_tol", 0.5))
     if warp_sep_tol < 0.0:
         raise ValueError(

@@ -122,6 +122,38 @@ def _bsc_bwd(gather_dtype, residuals, g):
 _bilinear_sample_cast.defvjp(_bsc_fwd, _bsc_bwd)
 
 
+def warp_coords(d_src: jnp.ndarray,
+                G_tgt_src: jnp.ndarray,
+                K_src_inv: jnp.ndarray,
+                K_tgt: jnp.ndarray,
+                meshgrid_tgt: jnp.ndarray,
+                src_hw: Tuple[int, int]):
+    """Source-pixel sampling coords for the inverse-homography warp.
+
+    The shared front half of `homography_warp`, factored out so the fused
+    render path (ops/rendering.py warp_impl="pallas_fused") computes coords
+    through the SAME ops as every other backend — one graph, one rounding
+    behavior.
+
+    Args: as homography_warp; src_hw = (H, W) of the source planes.
+    Returns: (x [B',Ht,Wt], y [B',Ht,Wt], valid [B',Ht,Wt] bool)
+    """
+    H, W = src_hw
+    Bp = d_src.shape[0]
+    _, Ht, Wt = meshgrid_tgt.shape
+    H_tgt_src = geometry.homography_tgt_src(K_tgt, K_src_inv, G_tgt_src, d_src)
+    H_src_tgt = jax.lax.stop_gradient(geometry.inverse_3x3(H_tgt_src))
+
+    grid = meshgrid_tgt.reshape(3, Ht * Wt)
+    src_homo = jnp.einsum("bij,jn->bin", H_src_tgt, grid)  # [B',3,HtWt]
+    src_xy = src_homo[:, 0:2, :] / src_homo[:, 2:3, :]
+    x = src_xy[:, 0, :].reshape(Bp, Ht, Wt)
+    y = src_xy[:, 1, :].reshape(Bp, Ht, Wt)
+
+    valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
+    return x, y, valid
+
+
 def homography_warp(src_BCHW: jnp.ndarray,
                     d_src: jnp.ndarray,
                     G_tgt_src: jnp.ndarray,
@@ -159,8 +191,11 @@ def homography_warp(src_BCHW: jnp.ndarray,
         "pallas" (banded MXU gather kernel, forward-only; caller must
         validate the band via kernels.warp.band_span), "pallas_diff"
         (banded fwd+bwd kernels with a built-in runtime gather fallback —
-        the Pallas training backend), or "pallas_sep" (Pallas fwd+bwd pair
-        of the separable form; kernels/warp_sep.py)
+        the Pallas training backend), "pallas_sep" (Pallas fwd+bwd pair
+        of the separable form; kernels/warp_sep.py), or "pallas_fused"
+        (under THIS warp-only contract: identical to pallas_diff; inside
+        render_tgt_rgb_depth it selects the warp+dequant+composite
+        megakernel, kernels/render_fused.py)
       mesh: ("data","plane") jax Mesh. With impl="pallas_diff"/"pallas_sep"
         on a multi-device mesh the kernel runs under shard_map with the
         flat B' axis split over data*plane (matching the decoder's B*S
@@ -186,16 +221,8 @@ def homography_warp(src_BCHW: jnp.ndarray,
     Bp, C, H, W = src_BCHW.shape
     _, Ht, Wt = meshgrid_tgt.shape
 
-    H_tgt_src = geometry.homography_tgt_src(K_tgt, K_src_inv, G_tgt_src, d_src)
-    H_src_tgt = jax.lax.stop_gradient(geometry.inverse_3x3(H_tgt_src))
-
-    grid = meshgrid_tgt.reshape(3, Ht * Wt)
-    src_homo = jnp.einsum("bij,jn->bin", H_src_tgt, grid)  # [B',3,HtWt]
-    src_xy = src_homo[:, 0:2, :] / src_homo[:, 2:3, :]
-    x = src_xy[:, 0, :].reshape(Bp, Ht, Wt)
-    y = src_xy[:, 1, :].reshape(Bp, Ht, Wt)
-
-    valid = ((x > -1.0) & (x < float(W)) & (y > -1.0) & (y < float(H)))
+    x, y, valid = warp_coords(d_src, G_tgt_src, K_src_inv, K_tgt,
+                              meshgrid_tgt, (H, W))
 
     # diagnostic only — mirrors each guarded backend's fallback decision
     # (NaN = backend has no runtime guard to measure)
@@ -226,14 +253,18 @@ def homography_warp(src_BCHW: jnp.ndarray,
             tgt = warp_separable.separable_bilinear_sample_guarded(
                 src_BCHW, xs, ys, band=band, mxu_dtype=mxu_dtype,
                 sep_tol=sep_tol)
-    elif impl in ("pallas_diff", "pallas_sep"):
+    elif impl in ("pallas_diff", "pallas_sep", "pallas_fused"):
         # training paths: Pallas fwd+bwd with runtime gather fallback
         # outside each backend's domain (kernels/warp_vjp.py — 2D band;
         # kernels/warp_sep.py — anchor band + separability). Coords are
         # non-learnable (no-grad inverse above), so stop_gradient keeps the
         # two branches' autodiff structurally identical.
         from mine_tpu.kernels import on_tpu_backend
-        if impl == "pallas_diff":
+        if impl in ("pallas_diff", "pallas_fused"):
+            # "pallas_fused" fuses warp+dequant+composite inside
+            # render_tgt_rgb_depth (kernels/render_fused.py); under the
+            # warp-only contract here it is the banded pallas_diff warp —
+            # same band geometry, same guard, same VJP
             from mine_tpu.kernels.warp_vjp import (
                 bilinear_sample_diff_guarded, guard_ok)
             fn = functools.partial(bilinear_sample_diff_guarded,

@@ -237,6 +237,30 @@ class RenderEngine:
                      warp_impl: str):
         """planes [R,S,4,H,W] (quantized) + request gather idx [P] +
         poses G [P,4,4] -> (rgb [P,3,H,W], depth [P,1,H,W])."""
+        if warp_impl == "pallas_fused":
+            # no pre-dequant: the render megakernel reads the quantized
+            # cache entries directly (scales in SMEM, dequant in registers,
+            # kernels/render_fused.py) — the float volume never hits HBM.
+            # Only the cheap [P]-gather of the cache slice happens here.
+            H, W = planes.shape[-2], planes.shape[-1]
+            grid = geometry.cached_pixel_grid(H, W)
+            xyz_src = geometry.plane_xyz_src(grid, disp, K_inv)
+            xyz_tgt = geometry.plane_xyz_tgt(xyz_src[idx], G)
+            pq = planes[idx]
+            psc = scales[idx] if planes.dtype == jnp.int8 else None
+            res = rendering.render_tgt_rgb_depth(
+                pq[:, :, 0:3], pq[:, :, 3:4], disp[idx], xyz_tgt, G,
+                K_inv[idx], K[idx],
+                use_alpha=self.use_alpha,
+                is_bg_depth_inf=self.is_bg_depth_inf,
+                backend=self.backend,
+                warp_impl=warp_impl,
+                warp_band=self.warp_band,
+                warp_dtype=self.warp_dtype,
+                warp_sep_tol=self.warp_sep_tol,
+                mesh=self._render_mesh(),
+                planes_q=pq, planes_scales=psc)
+            return res.rgb, res.depth
         x = planes.astype(jnp.float32)
         if planes.dtype == jnp.int8:
             x = x * scales  # fused dequant: int8 never leaves this program
@@ -264,6 +288,14 @@ class RenderEngine:
         (serve/shardmap.py) overrides this to device_put each operand under
         its NamedSharding so the jitted program spans the serving mesh."""
         return planes, scales, disp, K, K_inv, idx, poses
+
+    def _render_mesh(self):
+        """Serving mesh for the fused render path (warp_impl=
+        "pallas_fused"): None on the single-device engine; the mesh engine
+        (serve/shardmap.py) returns its Mesh so the megakernel runs under
+        shard_map, batch-split over the mesh's leading axis. The other
+        warp backends partition via GSPMD and never consult this."""
+        return None
 
     def _render_span_fields(self) -> dict:
         """Extra fields for a request trace's "render" span; the mesh
@@ -358,7 +390,7 @@ class RenderEngine:
         telemetry.emit("serve.bucket_compile", entries_bucket=bucket[0],
                        poses_bucket=bucket[1], warp_impl=bucket[2],
                        dtype=bucket[3], compile_ms=round(load_ms, 3),
-                       store_hit=True)
+                       store_hit=True, backend=bucket[2])
         return True
 
     def _call(self, entries: Sequence[MPIEntry], idx: np.ndarray,
@@ -429,9 +461,14 @@ class RenderEngine:
                            poses_bucket=Pb, warp_impl=warp_impl,
                            dtype=str(planes.dtype),
                            compile_ms=round(elapsed_ms, 3),
-                           store_hit=store_hit)
+                           store_hit=store_hit, backend=warp_impl)
         else:
             telemetry.histogram("serve.render_call_ms").record(elapsed_ms)
+            # per-backend label (a separate registry name, not a schema
+            # change): lets obs_report attribute warm render-time movement
+            # to the kernel backend that produced it
+            telemetry.histogram(
+                f"serve.render_call_ms[{warp_impl}]").record(elapsed_ms)
         if traces:
             # two host-side spans per traced rider: the stack/pad/place
             # work before dispatch, then the device call itself (dispatch

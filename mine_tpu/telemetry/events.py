@@ -254,8 +254,12 @@ KIND_FIELDS: Dict[str, tuple] = {
     "span": ("name", "ms"),
     "trace.span": ("trace", "span", "name", "ms", "t_off_ms"),
     "serve.sync_encode": ("image_id",),
+    # "backend" appended (mtpu-ev1 append-only): the kernel backend the
+    # bucket's program compiled against — same value as warp_impl today,
+    # carried separately so obs_report can attribute render-time movement
+    # to the backend without parsing program keys
     "serve.bucket_compile": ("entries_bucket", "poses_bucket", "warp_impl",
-                             "dtype", "compile_ms", "store_hit"),
+                             "dtype", "compile_ms", "store_hit", "backend"),
     "serve.slo_point": ("offered_qps", "achieved_qps", "p50_ms", "p99_ms"),
     "serve.coldstart_point": ("cold_p99_on_ms", "cold_p99_off_ms",
                               "warm_p99_ms", "boot_on_ms", "loads",

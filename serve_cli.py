@@ -154,6 +154,7 @@ def main():
         use_alpha=bool(config.get("mpi.use_alpha", False)),
         is_bg_depth_inf=bool(config.get("mpi.is_bg_depth_inf", False)),
         backend=backend,
+        warp_impl=serve_cfg.warp_backend,
         warp_band=WARP_BAND)
     aot_store = (AOTStore(serve_cfg.aot_store_dir)
                  if serve_cfg.aot_store_dir else None)

@@ -67,6 +67,7 @@ QUICK = {
     "test_plane_scan.py::test_single_plane_shard_degenerates_to_serial",
     "test_realestate10k.py::test_parse_camera_file",
     "test_recorder.py::test_dump_arms_profiler_request_once",
+    "test_render_fused.py::test_int8_roundtrip_bound_survives_fused_read",
     "test_rendering.py::test_alpha_composition_two_planes",
     "test_sampling.py::test_stratified_linspace_bins",
     "test_serve.py::test_lru_eviction_order_under_byte_budget",
@@ -120,6 +121,10 @@ MEDIUM_FILES = {
     # deadlines, shard failover — all chaos-driven) plus its default-off
     # bitwise parity bar: same reviewer concern as the two above
     "test_serve_resilience.py",
+    # the render megakernel's parity/dequant/guard contracts (~2 min of
+    # the tier's budget): what a reviewer most wants re-run after touching
+    # the kernels, the serve engine, or the cache quant modes
+    "test_render_fused.py",
     # the streaming-session plane over the fleet (keyframe cadence, shard
     # stickiness, K=1 bitwise parity with per-frame encode): same reviewer
     # concern as the serve suites above (~30 s)

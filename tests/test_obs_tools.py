@@ -217,6 +217,7 @@ _EXEMPLAR_VALUES = {
     "scope": "serve",
     "trace_dir": "/tmp/trace",
     "warp_impl": "xla",
+    "backend": "xla",
     "dtype": "bfloat16",
     "image_id": "img0000",
     "name": "render",

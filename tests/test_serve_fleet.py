@@ -2,9 +2,10 @@
 
 The load-bearing contracts, each asserted here:
   * the mesh render program is BITWISE-identical to the single-device
-    engine on 1/2/4-device CPU meshes, per quant mode, including padded
+    engine on 1/2/4/8-device CPU meshes, per quant mode, including padded
     pose/entry buckets (the per-pose-independent program shards cleanly
-    along "batch"); 8 devices rides the existing GSPMD xfail marker;
+    along "batch"; 8x1/4x2 graduated from the GSPMD xfail marker once
+    measured bitwise-clean — only the TRAIN step still diverges at 8);
   * key-range ownership is a pure function of (image_id, num_shards):
     deterministic, contiguous ranges, every shard reachable;
   * `ShardedPlaneCache` routes lookups to the owner shard, places encodes
@@ -128,11 +129,18 @@ def test_render_shardings_specs():
 
 
 @pytest.mark.parametrize("quant", ["bf16", "int8", "float32"])
-@pytest.mark.parametrize("mesh", [(1, 1), (2, 1), (2, 2), (4, 1)])
+@pytest.mark.parametrize("mesh", [(1, 1), (2, 1), (2, 2), (4, 1),
+                                  (8, 1), (4, 2)])
 def test_mesh_render_bitwise_matches_single_device(scene, mesh, quant):
     """The acceptance bar: the ONE jitted mesh render program with
     NamedSharding specs is bitwise-identical to the single-device engine —
-    every mesh shape x quant mode, on P=5 poses padded to an 8-bucket."""
+    every mesh shape x quant mode, on P=5 poses padded to an 8-bucket.
+
+    8x1 and 4x2 used to sit under the 8-device GSPMD xfail marker
+    (ROADMAP 'Mesh-vs-single numeric divergence at 8 CPU devices'); the
+    per-pose-independent RENDER program measured bitwise-clean on both, so
+    they graduated to plain parity cases. The TRAIN-step divergence remains
+    tracked separately — only render is promoted here."""
     mb, mm = mesh
     single = _put_scene(RenderEngine(cache=MPICache(quant=quant),
                                      max_bucket=8), scene)
@@ -180,26 +188,6 @@ def test_mesh_render_many_entry_padding_bitwise(scene):
     for (rgb_s, dep_s), (rgb_m, dep_m) in zip(out_s, out_m):
         np.testing.assert_array_equal(rgb_m, rgb_s)
         np.testing.assert_array_equal(dep_m, dep_s)
-
-
-@pytest.mark.xfail(
-    strict=False,
-    reason="ROADMAP 'Mesh-vs-single numeric divergence at 8 CPU devices': "
-           "the GSPMD partitioner diverges on 8-device CPU meshes for the "
-           "TRAIN step; the per-pose-independent render program measured "
-           "bitwise-clean at 8x1 and 4x2 when this landed, so this is "
-           "expected to XPASS — kept under the marker per the tracked "
-           "8-device policy, loud on XPASS, never red if jax regresses.")
-def test_mesh_render_8dev_matches_single_device(scene):
-    single = _put_scene(RenderEngine(cache=MPICache(quant="bf16"),
-                                     max_bucket=8), scene)
-    fleet = _put_scene(MeshRenderEngine(mesh_batch=8,
-                                        cache=MPICache(quant="bf16"),
-                                        max_bucket=8), scene)
-    rgb_s, depth_s = single.render("img", scene["poses"])
-    rgb_m, depth_m = fleet.render("img", scene["poses"])
-    np.testing.assert_array_equal(rgb_m, rgb_s)
-    np.testing.assert_array_equal(depth_m, depth_s)
 
 
 def test_mesh_model_axis_requires_divisible_planes(scene):
@@ -416,9 +404,14 @@ def test_serve_fleet_from_config_and_scheduler_validation():
 
 def test_serve_config_rejects_bad_fleet_keys():
     for bad in ({"serve.mesh_batch": 3}, {"serve.mesh_model": 0},
-                {"serve.cache_shards": 0}, {"serve.scheduler": "nope"}):
+                {"serve.cache_shards": 0}, {"serve.scheduler": "nope"},
+                {"serve.warp_backend": "auto"}):
         with pytest.raises(ValueError):
             serve_config_from_dict(bad)
     cfg = serve_config_from_dict({})
     assert cfg.mesh_batch == 1 and cfg.mesh_model == 1
     assert cfg.cache_shards == 1 and cfg.scheduler == "continuous"
+    # default "xla" keeps the engine byte-identical to pre-megakernel
+    assert cfg.warp_backend == "xla"
+    fused = serve_config_from_dict({"serve.warp_backend": "pallas_fused"})
+    assert fused.warp_backend == "pallas_fused"

@@ -77,6 +77,11 @@ LEVERS = [
     {"name": "serve_slo", "mesh": True, "trace_sample": "0.05"},
     {"name": "aot_coldstart", "variant": "serve_coldstart"},
     {"name": "stream_session"},
+    # megakernel lever: renderpass_b4 already sweeps every warp backend
+    # including pallas_fused — this alias keys the fused reading under its
+    # own conductor record so promote/regress tracks the megakernel
+    # against the r05 serve prior directly
+    {"name": "render_fused", "variant": "renderpass_b4"},
 ]
 
 PROMOTE_AT = 1.05

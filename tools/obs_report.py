@@ -159,9 +159,10 @@ def report(events, log_lines):
                    "%d store load(s)):" % (len(compiles), len(live),
                                            len(loads)))
         for e in compiles:
-            out.append("  R=%-4s P=%-4s %-12s %-10s %8.0f ms  [%s]"
+            out.append("  R=%-4s P=%-4s %-12s %-10s %-12s %8.0f ms  [%s]"
                        % (e.get("entries_bucket"), e.get("poses_bucket"),
                           e.get("warp_impl"), e.get("dtype"),
+                          e.get("backend") or "-",
                           float(e.get("compile_ms", 0.0)),
                           "load" if e.get("store_hit") else "compile"))
         out.append("  cold-start: %.0f ms live compile, %.0f ms store load"
@@ -345,6 +346,25 @@ def report(events, log_lines):
             if isinstance(v, dict):  # histogram stat dict
                 v = json.dumps(v, sort_keys=True)
             out.append("  %-32s %s" % (name, v))
+        # per-backend warm render latency: the serve engine records both
+        # serve.render_call_ms and serve.render_call_ms[<backend>], so a
+        # latency shift can be attributed to the kernel backend that moved
+        by_backend = {}
+        for name, v in metrics.items():
+            if (name.startswith("serve.render_call_ms[")
+                    and name.endswith("]") and isinstance(v, dict)):
+                by_backend[name[len("serve.render_call_ms["):-1]] = v
+        if by_backend:
+            out.append("")
+            out.append("warm render latency by backend (ms):")
+            out.append("  %-14s %7s %9s %9s %9s"
+                       % ("backend", "count", "mean", "p50", "p99"))
+            for backend, v in sorted(by_backend.items()):
+                out.append("  %-14s %7s %9.2f %9.2f %9.2f"
+                           % (backend, v.get("count", 0),
+                              float(v.get("mean", 0.0)),
+                              float(v.get("p50", 0.0)),
+                              float(v.get("p99", 0.0))))
 
     # a stream with events but no serve-path activity says so, instead of
     # silently omitting every serve section (which reads as "serve was
@@ -395,9 +415,22 @@ def report_json(events, log_lines):
         {"entries_bucket": e.get("entries_bucket"),
          "poses_bucket": e.get("poses_bucket"),
          "warp_impl": e.get("warp_impl"), "dtype": e.get("dtype"),
+         "backend": e.get("backend"),
          "compile_ms": float(e.get("compile_ms", 0.0)),
          "store_hit": bool(e.get("store_hit"))}
         for e in events if e.get("kind") == "serve.bucket_compile"]
+
+    # per-backend warm render latency from the last metrics snapshot: the
+    # engine records serve.render_call_ms[<backend>] beside the unlabeled
+    # histogram, so dashboards can attribute movement to a kernel backend
+    snaps = [e for e in events if e.get("kind") == "metrics.snapshot"]
+    render_by_backend = {}
+    if snaps:
+        for name, v in (snaps[-1].get("metrics") or {}).items():
+            if (name.startswith("serve.render_call_ms[")
+                    and name.endswith("]") and isinstance(v, dict)):
+                render_by_backend[name[len("serve.render_call_ms["):-1]] = v
+    out["render_ms_by_backend"] = render_by_backend
 
     out["slo_breaches"] = [
         {k: e.get(k) for k in ("ts", "p99_ms", "objective_ms", "window_s",

@@ -165,6 +165,17 @@ VARIANTS = {
     # without a full soak; compare against the pre-fusion row in
     # BENCH_NOTES to price the shared-pyramid/batched-SSIM win on chip.
     "losspass_b4": (4, {}),
+    # STAGED-PIPELINE row (not a fused-step variant): the GPipe-style
+    # executor (mine_tpu/parallel/pipeline.py) driving the four staged
+    # sub-programs — encoder / decoder / warp+composite / fused loss —
+    # fwd+bwd with gradient accumulation, swept over stages x microbatches
+    # (stages > 1 only when the visible device count divides; stage wall
+    # timing off inside the timed region so the overlapped schedule is
+    # what's measured). One parseable stderr curve line; JSON ips = the
+    # 1-stage x 1-microbatch reading — the staged step at its closest to
+    # the fused program, so the fused-vs-staged dispatch overhead is
+    # directly readable against flagship_b4.
+    "pipepass_b4": (4, {}),
     # WARP-ONLY row (not a train-step variant): times homography_warp
     # fwd+bwd in isolation on fixed decoder outputs — losspass_b4 one layer
     # deeper — once per warp backend (xla / xla_banded / pallas_diff /
@@ -401,6 +412,84 @@ def _measure_losspass(name, steps=MEASURE_STEPS, keep_run=False, extra=None):
           "only)" % (steps, dt, 1e3 * dt / steps), file=sys.stderr)
     return batch_size * steps / dt, tflops, (run if keep_run else None), \
         batch_size
+
+
+def _measure_pipepass(name, steps=MEASURE_STEPS, keep_run=False):
+    """Staged-pipeline measurement (the pipepass_* variants).
+
+    Builds the variant trainer with training.pipeline.enabled and drives
+    the executor's step (host-scheduled fill/drain over the four staged
+    sub-programs) on a resident batch, once per (stages, microbatches)
+    sweep point. Stage counts beyond 1 need a mesh: they're included only
+    when the visible device count is divisible, with the variant's batch
+    kept GLOBAL (not per-device) so every point runs the same problem.
+    Executor stage timing is disabled inside the timed region — the
+    block_until_ready telemetry would serialize the very overlap this row
+    prices. Points where microbatches don't divide the batch are skipped.
+    JSON ips = the 1-stage x 1-microbatch point."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from mine_tpu.data.synthetic import make_batch
+    from mine_tpu.parallel import mesh as mesh_lib
+    from mine_tpu.train.step import SynthesisTrainer
+
+    ndev = len(jax.devices())
+    stage_counts = [1] + [s for s in (2, 4)
+                          if ndev > 1 and ndev % s == 0 and s <= ndev]
+    batch_size, _ = VARIANTS[name]
+    micro_counts = [m for m in (1, 2, 4) if batch_size % m == 0]
+
+    points = []  # (stages, microbatches, ips, run_fn)
+    for stages in stage_counts:
+        config, _ = _variant_config(name, extra={
+            "training.pipeline.enabled": True,
+            "training.pipeline.stages": stages,
+            "training.pipeline.microbatches": 1,
+        })
+        mesh = mesh_lib.make_mesh() if stages > 1 else None
+        trainer = SynthesisTrainer(config, mesh=mesh, steps_per_epoch=10_000)
+        state = trainer.init_state(batch_size=batch_size)
+        h, w = int(config["data.img_h"]), int(config["data.img_w"])
+        batch = trainer.put_batch(
+            {k: np.asarray(v) for k, v in
+             make_batch(batch_size, h, w, num_points=256).items()})
+        for micro in micro_counts:
+            trainer._pipeline.cfg = dataclasses.replace(
+                trainer._pipeline.cfg, microbatches=micro)
+            trainer._pipeline.time_stages = False
+
+            for _ in range(WARMUP_STEPS):
+                state, metrics = trainer.train_step(state, batch)
+            jax.block_until_ready(metrics)
+
+            def run(n, trainer=trainer, batch=batch):
+                nonlocal state
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    state, metrics = trainer.train_step(state, batch)
+                # chained through state: the last loss bounds all n steps
+                float(jax.device_get(jax.tree.leaves(metrics)[0]))
+                return time.perf_counter() - t0
+
+            n = max(1, steps // 2)  # sweep row: half-length per point
+            dt = run(n)
+            ips = batch_size * n / dt
+            points.append((stages, micro, ips,
+                           run if (stages, micro) == (1, 1) else None))
+            print("  pipepass: stages=%d microbatches=%d -> %.1f ms/step "
+                  "(%.3f img/s)" % (stages, micro, 1e3 * dt / n, ips),
+                  file=sys.stderr)
+
+    # one parseable curve line (the bench-notes contract, like
+    # "amortize curve:"): s<stages>xm<microbatches>=img/s pairs
+    print("  pipepass curve: " + " ".join(
+        "s%dxm%d=%.3f" % (s, m, ips) for s, m, ips, _ in points),
+        file=sys.stderr)
+    head = next((p for p in points if p[0] == 1 and p[1] == 1), points[0])
+    return head[2], None, (head[3] if keep_run else None), batch_size
 
 
 # the warppass sub-sweep order: gather reference first, then the banded
@@ -1251,6 +1340,8 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
         return _measure_stream_session(name, steps=steps, keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
+    if name.startswith("pipepass"):
+        return _measure_pipepass(name, steps=steps, keep_run=keep_run)
     if name.startswith("losspass"):
         return _measure_losspass(name, steps=steps, keep_run=keep_run)
 

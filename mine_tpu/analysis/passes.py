@@ -544,13 +544,83 @@ class AOTStalenessPass(AuditPass):
             return check.run_global()
 
 
+# ------------------------------------------------------------ pipeline plan
+
+class PipelinePlanPass(AuditPass):
+    """Plans the staged train step (parallel/pipeline.py) from the
+    baseline's pipe_* cost rows (analysis/planner.py): the pass fails when
+    a stage program has no pinned cost row, or when no contiguous stage
+    partition fits the declared per-chip HBM budget
+    (MINE_TPU_PIPELINE_HBM_BUDGET_GB, default 16.0 — a v5e chip). A red
+    gate here means the cost rows drifted to where the documented pipeline
+    deployment no longer fits — the regression must be acknowledged (budget
+    raised, or the growth reverted) before it ships."""
+
+    name = "pipeline_plan"
+    scope = "global"
+
+    DEFAULT_BUDGET_GB = 16.0
+
+    def __init__(self, baseline: Dict, budget_gb: Optional[float] = None):
+        self.baseline = baseline
+        self.budget_gb = budget_gb
+
+    def _budget_bytes(self) -> int:
+        import os
+        gb = self.budget_gb
+        if gb is None:
+            gb = float(os.environ.get("MINE_TPU_PIPELINE_HBM_BUDGET_GB",
+                                      self.DEFAULT_BUDGET_GB))
+        return int(gb * 2 ** 30)
+
+    def run_global(self) -> PassResult:
+        from mine_tpu.analysis import planner as _planner
+        cost = self.baseline.get("cost", {})
+        missing = [p for p in _planner.PIPE_PROGRAMS if p not in cost]
+        if missing:
+            return self._result(
+                "-", ok=False,
+                details="no cost baseline entry for "
+                        + ", ".join(missing)
+                        + " — run tools/audit.py --update-baseline on a "
+                          "green build",
+                missing=missing)
+        budget = self._budget_bytes()
+        try:
+            plan = _planner.plan_stages(cost, budget)
+        except _planner.PlanInfeasibleError as e:
+            return self._result("-", ok=False,
+                                details=str(e)[:300],
+                                budget_bytes=budget)
+        cuts = " | ".join("+".join(n.removeprefix("pipe_") for n in names)
+                          for names in plan["cuts"])
+        det = (f"{plan['stages']} stage(s) [{cuts}] fit "
+               f"{budget / 2 ** 30:.1f} GiB/chip; bottleneck "
+               f"{plan['bottleneck_ms']:.3f} ms, advisory "
+               f"microbatches={plan['microbatches']}")
+        return self._result("-", ok=True, details=det, plan=plan)
+
+    def selftest(self) -> PassResult:
+        # seeded violation: a synthetic cost table no partition of which
+        # can fit a one-KiB budget — the infeasibility path MUST fail
+        from mine_tpu.analysis.planner import PIPE_PROGRAMS
+        row = {"flops": 10 ** 9, "bytes_accessed": 10 ** 6,
+               "argument_bytes": 10 ** 5, "output_bytes": 10 ** 5,
+               "temp_bytes": 10 ** 5, "alias_bytes": 0,
+               "peak_hbm_bytes": 10 ** 8}
+        seeded = PipelinePlanPass(
+            {"cost": {p: dict(row) for p in PIPE_PROGRAMS}},
+            budget_gb=1024 / 2 ** 30)  # 1 KiB
+        return seeded.run_global()
+
+
 # ---------------------------------------------------------------- suites
 
 def default_passes(baseline: Dict) -> List[AuditPass]:
     return [DtypeUpcastPass(), DotBudgetPass(baseline),
             CostBudgetPass(baseline), RecompileChurnPass(),
             TransferGuardPass(), DonationPass(), ConcurrencyPass(),
-            AOTStalenessPass()]
+            AOTStalenessPass(), PipelinePlanPass(baseline)]
 
 
 def pass_by_name(name: str, baseline: Optional[Dict] = None) -> AuditPass:

@@ -1,0 +1,157 @@
+"""Pipeline stage planner over the AOT cost model.
+
+Consumes the per-stage cost rows the audit baseline pins for the four
+staged train-step sub-programs (analysis/programs.py: pipe_encode,
+pipe_decode, pipe_render, pipe_loss — COST_KEYS from costmodel.py, i.e.
+XLA's own post-fusion flops/bytes/peak-HBM numbers) and proposes how to
+cut the chain into `training.pipeline.stages` contiguous groups under a
+declared per-chip HBM budget.
+
+The arithmetic is deliberately transparent and EXACT where it can be:
+
+  * a candidate stage's peak-HBM is the plain integer sum of its member
+    programs' `peak_hbm_bytes` rows — a conservative bound (members of one
+    stage run back-to-back inside one group of devices, so their peaks
+    don't in general coincide, but params+boundary buffers do persist) and
+    the quantity tests assert EXACTLY against the cost model;
+  * a candidate stage's step-time estimate is the sum of its members'
+    roofline expected_ms (costmodel.roofline — max of the compute and
+    memory legs under the declared chip model);
+  * feasibility = every stage's peak-HBM sum fits the budget; the planner
+    picks the FEWEST stages with any feasible partition (the fused step is
+    strictly better when it fits — no fill/drain bubble, no boundary
+    transfers, both unmodeled costs), and among partitions at that count
+    minimizes the BOTTLENECK stage time (pipeline throughput is set by the
+    slowest stage).
+
+The microbatch proposal is advisory scheduling math, not a memory model:
+GPipe's bubble fraction is (stages-1)/(M+stages-1), so the planner
+proposes the smallest M that keeps the bubble at or under 20% —
+M = 4*(stages-1), floored at 1.
+
+Consumers: tools/pipeline_plan.py (CLI) and the `pipeline_plan` audit pass
+(analysis/passes.py), which gates that the baselined cost rows still admit
+a feasible plan under the declared budget.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from mine_tpu.analysis import costmodel as _costmodel
+
+# the staged sub-programs, in dataflow order (must match
+# parallel/pipeline.py STAGE_NAMES and the analysis/programs.py registry)
+PIPE_PROGRAMS = ("pipe_encode", "pipe_decode", "pipe_render", "pipe_loss")
+
+MAX_BUBBLE_FRAC = 0.20
+
+
+class PlanInfeasibleError(ValueError):
+    """No contiguous stage partition fits the declared HBM budget."""
+
+
+def contiguous_partitions(n: int, max_groups: int) \
+        -> Iterator[Tuple[Tuple[int, ...], ...]]:
+    """All partitions of range(n) into 1..max_groups CONTIGUOUS non-empty
+    groups, in (group count, lexicographic cut) order. n=4, max_groups=4
+    yields 8 partitions — small enough to enumerate exhaustively."""
+    for groups in range(1, min(max_groups, n) + 1):
+        yield from _cuts(tuple(range(n)), groups)
+
+
+def _cuts(items: Tuple[int, ...], groups: int) \
+        -> Iterator[Tuple[Tuple[int, ...], ...]]:
+    if groups == 1:
+        yield (items,)
+        return
+    # first group takes 1..len-(groups-1) items; recurse on the rest
+    for take in range(1, len(items) - groups + 2):
+        for rest in _cuts(items[take:], groups - 1):
+            yield (items[:take],) + rest
+
+
+def propose_microbatches(stages: int) -> int:
+    """Smallest M with GPipe bubble (stages-1)/(M+stages-1) <= 20%."""
+    if stages <= 1:
+        return 1
+    m = 1
+    while (stages - 1) / (m + stages - 1) > MAX_BUBBLE_FRAC:
+        m += 1
+    return m
+
+
+def plan_stages(cost_table: Dict[str, Dict[str, int]],
+                hbm_budget_bytes: int,
+                max_stages: int = 4,
+                programs: Sequence[str] = PIPE_PROGRAMS) -> Dict:
+    """Propose stage cuts for `programs` under `hbm_budget_bytes` per chip.
+
+    cost_table: {program name: COST_KEYS dict} (the audit baseline's
+    "cost" rows, or live costmodel.measure_program output).
+
+    Returns a plan dict:
+      stages        chosen stage count
+      cuts          list of per-stage program-name lists
+      per_stage     [{programs, peak_hbm_bytes (EXACT int sum of member
+                     rows), expected_ms}]
+      bottleneck_ms max per-stage expected_ms (pipeline throughput bound)
+      total_ms      sum of all stages' expected_ms (the fill latency)
+      microbatches  advisory M (propose_microbatches)
+      hbm_budget_bytes  echoed budget
+
+    Raises PlanInfeasibleError when no partition fits, KeyError when a
+    program's cost row is missing.
+    """
+    missing = [p for p in programs if p not in cost_table]
+    if missing:
+        raise KeyError(
+            f"cost rows missing for {missing}: run tools/audit.py "
+            "--update-baseline (or pass --measure to tools/pipeline_plan.py)")
+    hbm = [int(cost_table[p]["peak_hbm_bytes"]) for p in programs]
+    ms = [float(_costmodel.roofline(cost_table[p])["expected_ms"])
+          for p in programs]
+
+    best = None
+    tightest = None  # least-over-budget partition, for the error message
+    for part in contiguous_partitions(len(programs), max_stages):
+        if best is not None and len(part) > best["stages"]:
+            break  # fewest feasible stage count wins; done at that count
+        peaks = [sum(hbm[i] for i in grp) for grp in part]
+        times = [sum(ms[i] for i in grp) for grp in part]
+        worst_peak = max(peaks)
+        if worst_peak > hbm_budget_bytes:
+            if tightest is None or worst_peak < tightest[0]:
+                tightest = (worst_peak, part)
+            continue
+        bottleneck = max(times)
+        # strict < : ties keep the earlier (lexicographically-first) cut
+        if best is None or bottleneck < best["bottleneck_ms"]:
+            best = {
+                "stages": len(part),
+                "cuts": [[programs[i] for i in grp] for grp in part],
+                "per_stage": [
+                    {"programs": [programs[i] for i in grp],
+                     "peak_hbm_bytes": int(peaks[g]),
+                     "expected_ms": times[g]}
+                    for g, grp in enumerate(part)],
+                "bottleneck_ms": bottleneck,
+                "total_ms": sum(times),
+            }
+    if best is None:
+        worst_peak, part = tightest
+        raise PlanInfeasibleError(
+            f"no contiguous partition of {list(programs)} into <= "
+            f"{max_stages} stages fits hbm_budget_bytes="
+            f"{hbm_budget_bytes}: the best candidate "
+            f"{[[programs[i] for i in g] for g in part]} still peaks at "
+            f"{worst_peak} bytes; raise the budget, shrink the model, or "
+            f"add microbatching/remat headroom")
+    best["microbatches"] = propose_microbatches(best["stages"])
+    best["hbm_budget_bytes"] = int(hbm_budget_bytes)
+    # the invariant the acceptance test pins: every stage's reported
+    # peak-HBM is exactly the integer sum of its members' cost rows
+    for st in best["per_stage"]:
+        assert st["peak_hbm_bytes"] == sum(
+            int(cost_table[p]["peak_hbm_bytes"]) for p in st["programs"])
+    return best

@@ -202,6 +202,81 @@ def _build_fused_loss_bwd() -> Program:
                    args_fn=_loss_args_fn, tags=("loss",))
 
 
+# ------------------------------------------------------- pipeline stages
+
+@functools.lru_cache(maxsize=1)
+def _stage_fixture():
+    """Canonical inputs for the four pipeline stage programs (train/step.py
+    stage_encode/stage_decode/stage_render/stage_loss): the boundary
+    activations are materialized ONCE by running the real stage chain on
+    the tiny trainer, then cached as host trees — so pipe_decode is audited
+    on genuine encoder features, pipe_loss on genuine rendered pytrees.
+    These are the programs the pipeline executor jits per stage; their
+    cost rows feed tools/pipeline_plan.py."""
+    trainer, state_host, batch_host = _tiny_trainer()
+    B, S = TINY["batch"], TINY["planes"]
+    state = _device_tree(state_host)
+    batch = _device_tree(batch_host)
+    disp_host = np.tile(np.linspace(1.0, 0.2, S, dtype=np.float32)[None],
+                        (B, 1))
+    key = jax.random.PRNGKey(0)
+    feats, _ = trainer.stage_encode(state.params["backbone"],
+                                    state.batch_stats["backbone"],
+                                    batch["src_img"], key)
+    mpi, _ = trainer.stage_decode(state.params["decoder"],
+                                  state.batch_stats["decoder"],
+                                  feats, jnp.asarray(disp_host), key)
+    rendered = trainer.stage_render(mpi, jnp.asarray(disp_host), batch)
+    return (trainer, state_host, batch_host, disp_host,
+            _host_tree(feats), _host_tree(mpi), _host_tree(rendered))
+
+
+def _build_pipe_encode() -> Program:
+    trainer, state_host, batch_host, _, _, _, _ = _stage_fixture()
+
+    def args_fn():
+        state = _device_tree(state_host)
+        return (state.params["backbone"], state.batch_stats["backbone"],
+                jnp.asarray(batch_host["src_img"]), jax.random.PRNGKey(0))
+
+    return Program(name="pipe_encode", jit_fn=jax.jit(trainer.stage_encode),
+                   args_fn=args_fn, tags=("train", "pipeline"))
+
+
+def _build_pipe_decode() -> Program:
+    trainer, state_host, _, disp_host, feats_host, _, _ = _stage_fixture()
+
+    def args_fn():
+        state = _device_tree(state_host)
+        return (state.params["decoder"], state.batch_stats["decoder"],
+                _device_tree(feats_host), jnp.asarray(disp_host),
+                jax.random.PRNGKey(0))
+
+    return Program(name="pipe_decode", jit_fn=jax.jit(trainer.stage_decode),
+                   args_fn=args_fn, tags=("train", "pipeline"))
+
+
+def _build_pipe_render() -> Program:
+    trainer, _, batch_host, disp_host, _, mpi_host, _ = _stage_fixture()
+
+    def args_fn():
+        return (_device_tree(mpi_host), jnp.asarray(disp_host),
+                _device_tree(batch_host))
+
+    return Program(name="pipe_render", jit_fn=jax.jit(trainer.stage_render),
+                   args_fn=args_fn, tags=("train", "pipeline"))
+
+
+def _build_pipe_loss() -> Program:
+    trainer, _, batch_host, _, _, _, rendered_host = _stage_fixture()
+
+    def args_fn():
+        return (_device_tree(rendered_host), _device_tree(batch_host))
+
+    return Program(name="pipe_loss", jit_fn=jax.jit(trainer.stage_loss),
+                   args_fn=args_fn, tags=("train", "pipeline"))
+
+
 # ------------------------------------------------------------- warp backends
 
 def _build_warp(impl: str) -> Program:
@@ -352,6 +427,13 @@ _register("serve_render_fused",
           functools.partial(serve_render_program, "int8", None,
                             "serve_render_fused", "pallas_fused"))
 _register("eval_encode", _build_eval_encode)
+# the staged train step's four sub-programs (parallel/pipeline.py): their
+# cost rows are the planner's input (tools/pipeline_plan.py) and their dot
+# budgets pin each stage's trace independently of the fused step's
+_register("pipe_encode", _build_pipe_encode)
+_register("pipe_decode", _build_pipe_decode)
+_register("pipe_render", _build_pipe_render)
+_register("pipe_loss", _build_pipe_loss)
 
 
 def program_names() -> List[str]:

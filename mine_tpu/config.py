@@ -203,6 +203,64 @@ def resilience_config_from_dict(config: Dict[str, Any]) -> ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Pipeline-parallel training knobs (mine_tpu/parallel/pipeline.py;
+    README "Pipeline training"). All default off: with enabled=False the
+    fused train step runs untouched (bitwise-parity bar, like the other
+    default-off subsystems)."""
+    # training.pipeline.enabled: route train_step through the staged
+    # GPipe-style executor instead of the fused jitted step
+    enabled: bool = False
+    # training.pipeline.microbatches: microbatches per optimizer step; the
+    # global batch must divide evenly. Grads/metrics are averaged over
+    # microbatches; BN stats thread sequentially (ghost BN, like
+    # training.decoder_plane_chunks)
+    microbatches: int = 1
+    # training.pipeline.stages: mesh sub-slices the stage chain is placed
+    # on; must divide the mesh's data axis (1 = all stages share the full
+    # mesh, the single-host default)
+    stages: int = 1
+    # training.pipeline.hbm_budget_gb: per-chip HBM budget the planner
+    # (tools/pipeline_plan.py) cuts stages under; 0 = unconstrained
+    hbm_budget_gb: float = 0.0
+
+
+def pipeline_config_from_dict(config: Dict[str, Any]) -> PipelineConfig:
+    g = config.get
+
+    def val(key, default):
+        # None (an empty YAML value) means the default; an explicit 0 does
+        # NOT — it must reach the range checks below, not coerce to 1
+        v = g(key, default)
+        return default if v is None else v
+
+    out = PipelineConfig(
+        enabled=bool(g("training.pipeline.enabled", False)),
+        microbatches=int(val("training.pipeline.microbatches", 1)),
+        stages=int(val("training.pipeline.stages", 1)),
+        hbm_budget_gb=float(val("training.pipeline.hbm_budget_gb", 0.0)),
+    )
+    if out.microbatches < 1:
+        raise ValueError(
+            f"training.pipeline.microbatches must be >= 1, "
+            f"got {out.microbatches}")
+    if out.stages < 1:
+        raise ValueError(
+            f"training.pipeline.stages must be >= 1, got {out.stages}")
+    if out.stages > 4:
+        # the stage chain is encoder -> decoder -> render -> loss: there is
+        # nothing to place on a fifth slice
+        raise ValueError(
+            f"training.pipeline.stages must be <= 4 (the staged step has "
+            f"4 sub-programs), got {out.stages}")
+    if out.hbm_budget_gb < 0:
+        raise ValueError(
+            f"training.pipeline.hbm_budget_gb must be >= 0, "
+            f"got {out.hbm_budget_gb}")
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Render-only serving knobs (mine_tpu/serve; README "Serving").
 

@@ -77,10 +77,23 @@ class MPIPredictor(nn.Module):
     def __call__(self, src_imgs, disparity, train: bool):
         """src_imgs [B,H,W,3] in [0,1]; disparity [B,S] ->
         list of 4 volumes [B,S,4,H/2^s,W/2^s] (scale order 0,1,2,3)."""
+        return self.decode(self.encode(src_imgs, train), disparity, train)
+
+    def encode(self, src_imgs, train: bool):
+        """Backbone half, exposed as a stage boundary: src_imgs [B,H,W,3]
+        -> tuple of 5 feature maps (strides 2..32). Applied standalone via
+        `method="encode"` with only the backbone param/stat subtrees
+        (mine_tpu/parallel/pipeline.py); __call__ composes encode+decode so
+        the fused trace is unchanged."""
         # named_scope -> HLO metadata: profiler traces attribute time to
         # encoder vs decoder without guesswork
         with jax.named_scope("encoder"):
-            feats = self.backbone(src_imgs, train)
+            return self.backbone(src_imgs, train)
+
+    def decode(self, feats, disparity, train: bool):
+        """Decoder half (plane-chunk logic included): encoder feature tuple
+        + disparity [B,S] -> the 4-scale MPI list. Stage-boundary
+        counterpart of `encode` (applied via `method="decode"`)."""
         S = disparity.shape[1]
         chunks = self.plane_chunks
         if chunks > 1 and S % chunks != 0:

@@ -16,6 +16,10 @@ h2d_ms=35.2 data_errors=0
   * times are milliseconds with one decimal; data_errors is an int
   * new keys may only be APPENDED (parsers must ignore unknown tails);
     any other change bumps the schema tag
+  * appended keys so far: the pipeline executor's per-stage breakdown
+    (stage_encode_ms ... stage_update_ms, parallel/pipeline.py
+    STAGE_MS_KEYS), present only when training.pipeline.enabled — emitted
+    via format_step_line's `extra` dict, sorted, after data_errors
 
 parse_line/parse_lines also accept the LEGACY pre-st1 form, so logs from
 older runs keep summarizing (pinned by tests/test_step_breakdown.py).
@@ -44,13 +48,19 @@ _LEGACY_RE = re.compile(
     r"(?: data_errors = ([0-9]+))?")
 
 
-def format_step_line(times_ms: Dict[str, float], data_errors: int) -> str:
+def format_step_line(times_ms: Dict[str, float], data_errors: int,
+                     extra: Optional[Dict[str, float]] = None) -> str:
     """The st1 line (sans indentation). `times_ms` uses the train loop's
-    meter keys (step_ms/host_wait_ms/device_ms/h2d_ms)."""
+    meter keys (step_ms/host_wait_ms/device_ms/h2d_ms). `extra` holds
+    APPENDED numeric keys (e.g. the pipeline executor's stage_*_ms
+    breakdown), written after data_errors in sorted order — legal under
+    the append-only rule, and old parsers ignore them."""
     parts = ["time:", "schema=" + STEP_SCHEMA]
     for k in STEP_KEYS[:-1]:
         parts.append("%s=%.1f" % (k, float(times_ms[k])))
     parts.append("data_errors=%d" % int(data_errors))
+    for k in sorted(extra or {}):
+        parts.append("%s=%.1f" % (k, float(extra[k])))
     return " ".join(parts)
 
 
@@ -86,9 +96,11 @@ def parse_line(line: str) -> Optional[Dict[str, float]]:
 
 
 def parse_lines(lines: Iterable[str]) -> Dict[str, List[float]]:
-    """Aggregate many log lines -> {time key: [ms samples...]} over the four
-    TIME_KEYS (the tools/step_breakdown.py contract; data_errors is
-    per-line via parse_line for consumers that want it)."""
+    """Aggregate many log lines -> {time key: [ms samples...]}. The four
+    TIME_KEYS are always present (the tools/step_breakdown.py contract;
+    data_errors is per-line via parse_line for consumers that want it);
+    appended time keys that actually occur — e.g. the pipeline stage_*
+    breakdown — aggregate under their stripped (sans _ms) names too."""
     samples: Dict[str, List[float]] = {k: [] for k in TIME_KEYS}
     for line in lines:
         rec = parse_line(line)
@@ -96,4 +108,8 @@ def parse_lines(lines: Iterable[str]) -> Dict[str, List[float]]:
             continue
         for k in TIME_KEYS:
             samples[k].append(rec[k])
+        for k, v in rec.items():
+            if k in TIME_KEYS or k == "data_errors":
+                continue
+            samples.setdefault(k, []).append(v)
     return samples

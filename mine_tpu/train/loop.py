@@ -357,6 +357,7 @@ class TrainLoop:
         host_wait_s = 0.0
         h2d_ms_acc = 0.0
         steps_since_log = 0
+        stage_ms_acc = {}  # pipeline executor's per-stage breakdown
         while True:
             t0 = time.perf_counter()
             try:
@@ -383,6 +384,12 @@ class TrainLoop:
             step_in_epoch += 1
             gstep += 1
             steps_since_log += 1
+            pipe = self.trainer._pipeline
+            if pipe is not None and pipe.last_stage_ms:
+                # host-side wall times the executor already measured — no
+                # device sync here beyond what its own timing did
+                for k, v in pipe.last_stage_ms.items():
+                    stage_ms_acc[k] = stage_ms_acc.get(k, 0.0) + v
             self.profile.maybe_stop(gstep)
             faults.maybe_sigterm(gstep)  # chaos-test seam (no-op unplanned)
 
@@ -418,10 +425,14 @@ class TrainLoop:
                 }
                 times["device_ms"] = max(
                     0.0, times["step_ms"] - times["host_wait_ms"])
-                self._log_training(epoch, step_in_epoch, gstep, m, times)
+                stage_ms = {k: v / steps_since_log
+                            for k, v in stage_ms_acc.items()}
+                self._log_training(epoch, step_in_epoch, gstep, m, times,
+                                   stage_ms=stage_ms)
                 t_last = time.perf_counter()
                 host_wait_s = h2d_ms_acc = 0.0
                 steps_since_log = 0
+                stage_ms_acc = {}
 
             # checkpoint saves and eval are collective over the mesh: EVERY
             # process participates (orbax + jit would deadlock otherwise);
@@ -686,7 +697,7 @@ class TrainLoop:
                 "eta_s": None if avg_ms is None
                 else round(remaining * avg_ms / 1e3, 1)}
 
-    def _log_training(self, epoch, step, gstep, m, times):
+    def _log_training(self, epoch, step, gstep, m, times, stage_ms=None):
         lrs = current_lrs(self.config, self.trainer.steps_per_epoch, gstep)
         data_stats = PIPELINE_STATS.snapshot()
         # ops-plane state: written only here (log cadence, lead host), read
@@ -702,8 +713,12 @@ class TrainLoop:
         # the FROZEN parseable step-time line (schema st1 — see
         # telemetry/stepline.py; tools/step_breakdown.py and obs_report
         # both read it through the one shared parser)
+        # appended stage_*_ms keys (pipeline executor breakdown) ride the
+        # same line under the append-only rule — absent when pipelining
+        # is off, so non-pipeline logs are byte-identical to before
         step_line = telemetry.format_step_line(times,
-                                               data_stats["data_errors"])
+                                               data_stats["data_errors"],
+                                               extra=stage_ms or None)
         self._log(
             "epoch [%.3d] step [%d] global_step = %d total_loss = %.4f "
             "encoder_lr = %.7f step_time = %.3fs\n"

@@ -171,40 +171,37 @@ def _disp_loss(disp_syn_at_pts: jnp.ndarray, pt3d_disp: jnp.ndarray,
                     axis=(1, 2))
 
 
-def loss_per_scale(scale: int,
-                   plan_s: ScaleInputs,
-                   mpi: jnp.ndarray,
-                   disparity: jnp.ndarray,
-                   batch: Batch,
-                   G_tgt_src: jnp.ndarray,
-                   cfg: MPIConfig,
-                   scale_factor: Optional[jnp.ndarray],
-                   mesh=None,
-                   is_val: bool = False,
-                   lpips_params=None,
-                   example_weight: Optional[jnp.ndarray] = None,
-                   ) -> Tuple[Dict[str, jnp.ndarray],
-                              Dict[str, jnp.ndarray],
-                              jnp.ndarray]:
-    """One pyramid scale of the loss graph (synthesis_task.py:230-373).
+# warp backends with a runtime band-fit guard: render results carry a
+# warp_in_domain diagnostic that loss_terms_per_scale surfaces as the
+# warp_fallback metric (key absent on unguarded backends)
+GUARDED_WARP_BACKENDS = ("pallas_diff", "xla_banded", "separable",
+                         "pallas_sep")
 
-    Args:
-      plan_s: this scale's precomputed ScaleInputs (build_scale_plan)
-      mpi: [B,S,4,Hs,Ws] decoder output at this scale
-      disparity: [B,S]
-      scale_factor: [B] or None (computed here at scale 0)
-      example_weight: optional [B] weights for the batch-mean aggregation
-        (masked padded eval batches: 0-weight examples are excluded exactly;
-        jnp.where guards keep any garbage/NaN in padding examples out of the
-        weighted sum). None = plain batch mean (the training path).
-    Returns: (loss_dict, visuals, scale_factor)
 
-    Every metric is computed per-example first ([B]) and then aggregated —
-    mathematically identical to the reference's whole-batch means because
-    all examples share one image size.
+def render_per_scale(scale: int,
+                     plan_s: ScaleInputs,
+                     mpi: jnp.ndarray,
+                     disparity: jnp.ndarray,
+                     batch: Batch,
+                     G_tgt_src: jnp.ndarray,
+                     cfg: MPIConfig,
+                     scale_factor: Optional[jnp.ndarray],
+                     mesh=None) -> Dict[str, jnp.ndarray]:
+    """Render half of one scale: src composite (+ rgb blending), scale
+    factor, novel-view warp/composite (synthesis_task.py:230-295,435-474).
+
+    This is the warp/composite STAGE of the staged train step — its return
+    dict is the stage-boundary pytree the pipeline executor differentiates
+    the loss stage with respect to (mine_tpu/parallel/pipeline.py). The
+    fused path composes it with loss_terms_per_scale via loss_per_scale,
+    tracing exactly the ops of the pre-split function.
+
+    Returns a dict with src_syn, src_disp_syn, tgt_syn, tgt_mask,
+    tgt_disp_syn, scale_factor [B] (computed here at scale 0 when the
+    incoming one is None), plus src_pt_disp/src_pt_disp_syn when the
+    sparse-disparity loss is on and warp_in_domain on guarded backends.
     """
     src_imgs = plan_s.src_imgs
-    tgt_imgs = plan_s.tgt_imgs
     B = src_imgs.shape[0]
 
     K_src, K_tgt, K_src_inv = plan_s.K_src, plan_s.K_tgt, plan_s.K_src_inv
@@ -262,6 +259,49 @@ def loss_per_scale(scale: int,
     tgt_syn, tgt_mask = res.rgb, res.mask
     tgt_disp_syn = _safe_reciprocal_depth(res.depth)
 
+    rendered = {
+        "src_syn": src_syn,
+        "src_disp_syn": src_disp_syn,
+        "tgt_syn": tgt_syn,
+        "tgt_mask": tgt_mask,
+        "tgt_disp_syn": tgt_disp_syn,
+        "scale_factor": scale_factor,
+    }
+    if cfg.use_disparity_loss:
+        rendered["src_pt_disp"] = src_pt_disp
+        rendered["src_pt_disp_syn"] = src_pt_disp_syn
+    if cfg.warp_backend in GUARDED_WARP_BACKENDS:
+        rendered["warp_in_domain"] = res.warp_in_domain
+    return rendered
+
+
+def loss_terms_per_scale(scale: int,
+                         plan_s: ScaleInputs,
+                         rendered: Dict[str, jnp.ndarray],
+                         batch: Batch,
+                         cfg: MPIConfig,
+                         is_val: bool = False,
+                         lpips_params=None,
+                         example_weight: Optional[jnp.ndarray] = None,
+                         ) -> Tuple[Dict[str, jnp.ndarray],
+                                    Dict[str, jnp.ndarray]]:
+    """Loss-terms half of one scale over render_per_scale's output
+    (synthesis_task.py:296-373) — the LOSS stage of the staged step.
+
+    Every metric is computed per-example first ([B]) and then aggregated —
+    mathematically identical to the reference's whole-batch means because
+    all examples share one image size.
+    """
+    src_imgs = plan_s.src_imgs
+    tgt_imgs = plan_s.tgt_imgs
+    K_tgt = plan_s.K_tgt
+    src_syn = rendered["src_syn"]
+    src_disp_syn = rendered["src_disp_syn"]
+    tgt_syn = rendered["tgt_syn"]
+    tgt_mask = rendered["tgt_mask"]
+    tgt_disp_syn = rendered["tgt_disp_syn"]
+    scale_factor = rendered["scale_factor"]
+
     # ---- loss terms ----
     zero = jnp.zeros((), jnp.float32)
 
@@ -301,7 +341,8 @@ def loss_per_scale(scale: int,
                             edge_masks=plan_s.src_edge_masks)))
 
     if cfg.use_disparity_loss:
-        loss_disp_src = agg(_disp_loss(src_pt_disp_syn, src_pt_disp,
+        loss_disp_src = agg(_disp_loss(rendered["src_pt_disp_syn"],
+                                       rendered["src_pt_disp"],
                                        scale_factor))
         tgt_pt3d = batch["pt3d_tgt"]
         tgt_pt_disp = 1.0 / tgt_pt3d[:, 2:3]
@@ -370,12 +411,11 @@ def loss_per_scale(scale: int,
         "psnr_tgt": psnr_tgt,
         "loss_disp_pt3dtgt": loss_disp_tgt,
     }
-    if cfg.warp_backend in ("pallas_diff", "xla_banded",
-                            "separable", "pallas_sep"):
+    if "warp_in_domain" in rendered:
         # guard diagnostic, not a loss: 1.0 when this scale's guarded warp
         # backend bailed to the gather (key absent on unguarded backends)
         loss_dict["warp_fallback"] = jax.lax.stop_gradient(
-            1.0 - res.warp_in_domain)
+            1.0 - rendered["warp_in_domain"])
     visuals = {
         "src_disparity_syn": src_disp_syn,
         "tgt_disparity_syn": tgt_disp_syn,
@@ -383,7 +423,46 @@ def loss_per_scale(scale: int,
         "tgt_mask_syn": tgt_mask,
         "src_imgs_syn": src_syn,
     }
-    return loss_dict, visuals, scale_factor
+    return loss_dict, visuals
+
+
+def loss_per_scale(scale: int,
+                   plan_s: ScaleInputs,
+                   mpi: jnp.ndarray,
+                   disparity: jnp.ndarray,
+                   batch: Batch,
+                   G_tgt_src: jnp.ndarray,
+                   cfg: MPIConfig,
+                   scale_factor: Optional[jnp.ndarray],
+                   mesh=None,
+                   is_val: bool = False,
+                   lpips_params=None,
+                   example_weight: Optional[jnp.ndarray] = None,
+                   ) -> Tuple[Dict[str, jnp.ndarray],
+                              Dict[str, jnp.ndarray],
+                              jnp.ndarray]:
+    """One pyramid scale of the loss graph (synthesis_task.py:230-373):
+    render_per_scale composed with loss_terms_per_scale — the exact op
+    sequence of the pre-split function, so the fused step's trace (and its
+    pinned dot/cost baselines) is unchanged by the stage refactor.
+
+    Args:
+      plan_s: this scale's precomputed ScaleInputs (build_scale_plan)
+      mpi: [B,S,4,Hs,Ws] decoder output at this scale
+      disparity: [B,S]
+      scale_factor: [B] or None (computed here at scale 0)
+      example_weight: optional [B] weights for the batch-mean aggregation
+        (masked padded eval batches: 0-weight examples are excluded exactly;
+        jnp.where guards keep any garbage/NaN in padding examples out of the
+        weighted sum). None = plain batch mean (the training path).
+    Returns: (loss_dict, visuals, scale_factor)
+    """
+    rendered = render_per_scale(scale, plan_s, mpi, disparity, batch,
+                                G_tgt_src, cfg, scale_factor, mesh=mesh)
+    loss_dict, visuals = loss_terms_per_scale(
+        scale, plan_s, rendered, batch, cfg, is_val=is_val,
+        lpips_params=lpips_params, example_weight=example_weight)
+    return loss_dict, visuals, rendered["scale_factor"]
 
 
 def compute_losses(mpi_list,
@@ -417,6 +496,15 @@ def compute_losses(mpi_list,
         if scale == 0:
             visuals0 = vis
 
+    total, metrics = aggregate_scale_losses(dicts, cfg)
+    return total, metrics, visuals0
+
+
+def aggregate_scale_losses(dicts, cfg: MPIConfig):
+    """Cross-scale total + metrics over the per-scale loss dicts
+    (synthesis_task.loss_fcn :394-400) — shared by the fused compute_losses
+    and the staged loss_from_rendered so the two paths aggregate with the
+    identical sum order."""
     total = dicts[0]["loss"]
     for s in range(1, NUM_SCALES):
         if cfg.use_multi_scale:
@@ -433,4 +521,47 @@ def compute_losses(mpi_list,
         del metrics["warp_fallback"]
         metrics["warp_fallback_frac"] = jnp.mean(
             jnp.stack([d["warp_fallback"] for d in dicts]))
+    return total, metrics
+
+
+def render_all_scales(mpi_list, disparity: jnp.ndarray, batch: Batch,
+                      cfg: MPIConfig, mesh=None):
+    """The warp/composite STAGE of the staged train step: the render half
+    of all 4 scales, threading the scale-0 scale factor forward exactly as
+    compute_losses does. Returns a list of per-scale rendered dicts — the
+    stage-boundary pytree mine_tpu/parallel/pipeline.py carries cotangents
+    through."""
+    G_tgt_src = geometry.rigid_inverse(batch["G_src_tgt"])
+    plan = build_scale_plan(batch, cfg, num_scales=NUM_SCALES)
+    scale_factor = None
+    rendered = []
+    for scale in range(NUM_SCALES):
+        r = render_per_scale(scale, plan[scale], mpi_list[scale], disparity,
+                             batch, G_tgt_src, cfg, scale_factor, mesh=mesh)
+        scale_factor = r["scale_factor"]
+        rendered.append(r)
+    return rendered
+
+
+def loss_from_rendered(rendered_list, batch: Batch, cfg: MPIConfig,
+                       is_val: bool = False, lpips_params=None,
+                       example_weight=None):
+    """The fused-loss STAGE of the staged train step: loss terms + the
+    cross-scale aggregation over render_all_scales output. Composing
+    render_all_scales with this function computes the same math as
+    compute_losses (the scale plan is rebuilt here — pyramids/masks are
+    batch-only functions, cheaper to recompute than to ship across the
+    stage boundary). Returns (total, metrics, visuals_scale0)."""
+    plan = build_scale_plan(batch, cfg, num_scales=NUM_SCALES)
+    dicts = []
+    visuals0 = None
+    for scale in range(NUM_SCALES):
+        ld, vis = loss_terms_per_scale(
+            scale, plan[scale], rendered_list[scale], batch, cfg,
+            is_val=is_val, lpips_params=lpips_params,
+            example_weight=example_weight)
+        dicts.append(ld)
+        if scale == 0:
+            visuals0 = vis
+    total, metrics = aggregate_scale_losses(dicts, cfg)
     return total, metrics, visuals0

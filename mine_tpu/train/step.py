@@ -24,13 +24,15 @@ import optax
 
 from mine_tpu import geometry
 from mine_tpu.config import (MPIConfig, mpi_config_from_dict,
+                             pipeline_config_from_dict,
                              validate_model_shapes)
 from mine_tpu.models.mpi import MPIPredictor
 from mine_tpu.ops import rendering, sampling
 from mine_tpu.parallel import mesh as mesh_lib
 from mine_tpu.testing import faults
 from mine_tpu.train import resilience
-from mine_tpu.train.loss import compute_losses
+from mine_tpu.train.loss import (compute_losses, loss_from_rendered,
+                                 render_all_scales)
 from mine_tpu.train.state import (GUARD_CONSEC, GUARD_LAST_BAD, GUARD_SKIPPED,
                                   TrainState, create_train_state,
                                   make_optimizer)
@@ -207,6 +209,17 @@ class SynthesisTrainer:
         self._eval_losses = jit(self._eval_losses_impl)
         self._eval_losses_masked = jit(self._eval_losses_masked_impl)
 
+        # Pipeline-staged training (training.pipeline.*, default off):
+        # enabled routes train_step through the staged GPipe-style executor
+        # (mine_tpu/parallel/pipeline.py). With enabled=False nothing is
+        # constructed and the fused jitted step above runs untouched —
+        # bitwise-identical outputs, same-compiled program.
+        self.pipeline_cfg = pipeline_config_from_dict(config)
+        self._pipeline = None
+        if self.pipeline_cfg.enabled:
+            from mine_tpu.parallel.pipeline import PipelineExecutor
+            self._pipeline = PipelineExecutor(self, self.pipeline_cfg)
+
     # ---------------- batch geometry ----------------
 
     def global_batch_size(self) -> int:
@@ -323,9 +336,68 @@ class SynthesisTrainer:
             loss_fn, has_aux=True)(state.params)
         return grads, metrics, new_stats
 
+    # ---------------- staged sub-programs (pipeline path) ----------------
+    # The fused step above, cut at its natural seams: encoder -> decoder ->
+    # warp/composite -> fused loss. Each is a pure function of explicit
+    # param/stat subtrees, so the pipeline executor
+    # (mine_tpu/parallel/pipeline.py) can jit, place, and differentiate
+    # them independently, and analysis/programs.py registers each with its
+    # own dot/cost baseline row. Restricted to mpi.num_bins_fine == 0 (the
+    # coarse-to-fine refinement re-enters the model mid-render and has no
+    # stage boundary); the executor enforces that.
+
+    def stage_encode(self, backbone_params, backbone_stats, src_img,
+                     drop_key):
+        """Encoder stage: src images -> backbone feature pyramid.
+        Returns (feats, new_backbone_stats). Flax resolves the partial
+        {"backbone": ...} subtrees lazily, so only the backbone's
+        params/stats ever live on this stage's devices."""
+        feats, mut = self.model.apply(
+            {"params": {"backbone": backbone_params},
+             "batch_stats": {"backbone": backbone_stats}},
+            src_img, True, method="encode", mutable=["batch_stats"],
+            rngs={"dropout": drop_key})
+        return feats, mut["batch_stats"]["backbone"]
+
+    def stage_decode(self, decoder_params, decoder_stats, feats, disparity,
+                     drop_key):
+        """Decoder stage: feature pyramid + disparity -> 4-scale MPI list.
+        Returns (mpi_list, new_decoder_stats). The dropout rng folds the
+        same module path as the fused apply, so sigma-dropout masks match
+        the fused step exactly."""
+        mpi_list, mut = self.model.apply(
+            {"params": {"decoder": decoder_params},
+             "batch_stats": {"decoder": decoder_stats}},
+            list(feats), disparity, True, method="decode",
+            mutable=["batch_stats"], rngs={"dropout": drop_key})
+        return mpi_list, mut["batch_stats"]["decoder"]
+
+    def stage_render(self, mpi_list, disparity, batch, mesh=None):
+        """Warp/composite stage: the render half of all 4 loss scales
+        (train/loss.render_all_scales) -> list of per-scale rendered
+        pytrees, the boundary the loss stage's cotangent flows back
+        through."""
+        return render_all_scales(mpi_list, disparity, batch, self.cfg,
+                                 mesh=mesh)
+
+    def stage_loss(self, rendered, batch):
+        """Fused-loss stage: loss terms + cross-scale aggregation over the
+        rendered pytrees -> (total, metrics)."""
+        total, metrics, _ = loss_from_rendered(rendered, batch, self.cfg)
+        return total, metrics
+
     def _train_step_impl(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         key = jax.random.fold_in(state.rng, state.step)
         grads, metrics, new_stats = self._grads_and_metrics(state, batch, key)
+        return self._apply_update(state, grads, metrics, new_stats)
+
+    def _apply_update(self, state: TrainState, grads, metrics,
+                      new_stats) -> Tuple[TrainState, Dict]:
+        """Optimizer update + non-finite guard + layer telemetry over
+        already-computed (possibly pipeline-accumulated) gradients. The
+        fused step traces this inline; the pipeline executor jits it as its
+        own update program — one body, so both paths apply the identical
+        update/guard/metrics semantics."""
         if self._nan_grad_window is not None:
             # chaos-test seam: poison the gradients at the planned step(s);
             # absent a plan this branch is not traced at all
@@ -479,6 +551,8 @@ class SynthesisTrainer:
     # ---------------- public API ----------------
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        if self._pipeline is not None:
+            return self._pipeline.step(state, batch)
         return self._train_step(state, batch)
 
     def eval_step(self, state: TrainState, batch, eval_key):

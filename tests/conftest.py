@@ -76,6 +76,7 @@ QUICK = {
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
     "test_stream_session.py::test_keyframe_ids_share_prefix_and_owner_shard",
     "test_train.py::test_multistep_lr_schedule",
+    "test_train_pipeline.py::test_planner_cuts_under_budget",
     "test_warp.py::test_homography_warp_identity",
     "test_warp_banded.py::test_guard_falls_back_outside_domain",
     "test_warp_separable.py::test_integer_translation_bitwise",
@@ -102,6 +103,11 @@ MEDIUM_FILES = {
     "test_plane_scan.py",
     "test_train.py",
     "test_train_loop.py",
+    # the staged GPipe executor's parity bars (1x1 vs fused, bitwise
+    # microbatch accumulation, per-stage GSPMD parity) + the cost-model
+    # planner: what a reviewer most wants re-run after touching the train
+    # step, the loss split, or the cost model
+    "test_train_pipeline.py",
     "test_pipeline.py",
     "test_checkpoint.py",
     "test_chaos.py",
@@ -173,6 +179,7 @@ HEAVY_LAST_FILES = (
     "test_train_loop.py",
     "test_plane_scan.py",
     "test_train.py",
+    "test_train_pipeline.py",
     "test_train_variants.py",
 )
 

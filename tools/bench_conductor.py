@@ -82,6 +82,11 @@ LEVERS = [
     # own conductor record so promote/regress tracks the megakernel
     # against the r05 serve prior directly
     {"name": "render_fused", "variant": "renderpass_b4"},
+    # staged-pipeline lever: the GPipe-style executor's stages x
+    # microbatches sweep (bench.py pipepass_b4); the keyed ips is the
+    # 1-stage x 1-microbatch point, so promote/regress reads the staged
+    # step's dispatch overhead against the fused flagship directly
+    {"name": "train_pipeline", "variant": "pipepass_b4"},
 ]
 
 PROMOTE_AT = 1.05

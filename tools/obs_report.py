@@ -138,6 +138,11 @@ def report(events, log_lines):
         if rec:
             for k in stepline.TIME_KEYS:
                 steps[k + "_ms"].append(rec[k])
+            for k, v in rec.items():
+                # appended pipeline-stage breakdown keys (parse_line strips
+                # the _ms suffix; restore it for display parity)
+                if k.startswith("stage_"):
+                    steps[k + "_ms"].append(v)
     if steps:
         out.append("")
         out.append("step-time (train.step events + st1 log lines, ms):")
@@ -145,6 +150,9 @@ def report(events, log_lines):
                    % ("component", "count", "mean", "p50", "p90", "p99"))
         for k in stepline.STEP_KEYS[:-1]:
             if steps.get(k):
+                out.append(_stat_row(k, steps[k]))
+        for k in sorted(steps):
+            if k.startswith("stage_") and steps[k]:
                 out.append(_stat_row(k, steps[k]))
 
     compiles = [e for e in events if e.get("kind") == "serve.bucket_compile"]
@@ -408,6 +416,9 @@ def report_json(events, log_lines):
         if rec:
             for k in stepline.TIME_KEYS:
                 steps[k + "_ms"].append(rec[k])
+            for k, v in rec.items():
+                if k.startswith("stage_"):
+                    steps[k + "_ms"].append(v)
     out["step_time"] = {k: _stat_dict(v)
                         for k, v in sorted(steps.items()) if v}
 

@@ -47,11 +47,15 @@ def summarize(samples) -> str:
     if n == 0:
         return "no 'time: step = ...' breakdown lines found"
     out = ["step-time breakdown over %d log intervals (ms):" % n,
-           "  %-10s %10s %10s %10s" % ("component", "mean", "p50", "p90")]
-    for k in KEYS:
+           "  %-12s %10s %10s %10s" % ("component", "mean", "p50", "p90")]
+    # appended keys (e.g. the pipeline executor's stage_* breakdown) render
+    # after the four frozen components, in sorted order
+    extra = sorted(k for k in samples if k not in KEYS and samples[k])
+    for k in list(KEYS) + extra:
         vals = sorted(samples[k])
-        out.append("  %-10s %10.1f %10.1f %10.1f"
-                   % (k, sum(vals) / n, _pct(vals, 0.5), _pct(vals, 0.9)))
+        out.append("  %-12s %10.1f %10.1f %10.1f"
+                   % (k, sum(vals) / len(vals), _pct(vals, 0.5),
+                      _pct(vals, 0.9)))
     host_frac = sum(samples["host_wait"]) / max(sum(samples["step"]), 1e-9)
     out.append("  host-bound fraction (host_wait/step): %.1f%%"
                % (100.0 * host_frac))

@@ -76,6 +76,23 @@ if ! timeout -k 10 600 python tools/audit.py --gate \
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# the staged-pipeline numerics contract is tier-1 in its own right: the
+# wall-capped pytest window above truncates into the heavy train suites on
+# a slow box (ROADMAP "dots window vs box speed"), so the pipeline-off
+# bitwise bar and the staged-1x1-vs-fused parity bar are re-gated
+# explicitly here — a train-step or loss-split change that breaks the
+# staged decomposition fails tier-1 even when the window axed the suite
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_train_pipeline.py::test_pipeline_off_default_routes_fused_bitwise" \
+        "tests/test_train_pipeline.py::test_staged_1x1_matches_fused" \
+        -q -p no:cacheprovider -p no:randomly \
+        > /tmp/_t1_pipeline.txt 2>&1; then
+    tail -20 /tmp/_t1_pipeline.txt
+    echo "PIPELINE: staged-vs-fused parity gate failed (output in" \
+         "/tmp/_t1_pipeline.txt)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # the incident-bundle capture/read contract is tier-1: postmortem's
 # selftest pushes a synthetic incident through the REAL FlightRecorder
 # dump path, renders it, and asserts a corrupted copy is rejected — so a

@@ -229,6 +229,18 @@ VARIANTS = {
     # asserts the sync-encode invariant: exactly ceil(frames/K) encodes
     # per session. JSON ips = frames/s at the knee cadence.
     "stream_session": (1, {}),
+    # MULTI-HOST ring sweep (not a train-step variant; CPU subprocess
+    # hosts, no checkpoint): 2 -> 3 -> 4 hostnet processes boot from ONE
+    # packed AOT artifact — every host must join with zero live compiles
+    # — and a RingFront floods renders at each ring size. Aggregate
+    # views/s + remote-route fraction per host count as one parseable
+    # stderr line ("serve_multihost curve: H:views_per_sec:remote_frac
+    # ..."), plus a failover reading with one member drained so the
+    # remote fraction is exercised, not just reported as zero. JSON ips
+    # = views/s at the largest healthy ring; checkouts predating the
+    # variant skip the row through the unknown-variant path, which the
+    # bench conductor reads as neutral.
+    "serve_multihost": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -1291,6 +1303,168 @@ def _measure_stream_session(name, steps=MEASURE_STEPS, keep_run=False):
     return knee_fps, None, (run if keep_run else None), n_frames
 
 
+# host counts the serve_multihost variant sweeps (subprocess CPU hosts)
+SERVE_MULTIHOST_COUNTS = (2, 3, 4)
+
+
+def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
+    """Multi-host ring throughput sweep (the serve_multihost variant).
+
+    Boots max(SERVE_MULTIHOST_COUNTS) hostnet subprocess hosts from ONE
+    packed AOT artifact (the tools/aot_warmstore.py --pack unit: a builder
+    subprocess pays every compile, each host must then join with
+    aot_compiles == 0 — asserted), and floods a fixed request set through
+    a RingFront per ring size H over the first H hosts. Requests carry
+    their source image, so a key landing off its cached host sync-encodes
+    in place — the same discipline as the chaos soak's failover traffic.
+    After the healthy sweep, one extra reading repeats the largest ring
+    with a member drained ring-side, so the remote-route fraction is a
+    measured failover number instead of a structural zero. One parseable
+    stderr line; JSON ips = views/s at the largest healthy ring."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from mine_tpu.serve import HostClient, HostRing, RingFront
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    counts = SERVE_MULTIHOST_COUNTS[:2] if SMOKE else SERVE_MULTIHOST_COUNTS
+    n_req = 24 if SMOKE else 128
+    n_keys = 8
+    workdir = tempfile.mkdtemp(prefix="mtpu_multihost_bench_")
+    artifact = os.path.join(workdir, "aot.pack.tar")
+    env = dict(os.environ, PYTHONPATH=repo)
+    hostnet = [sys.executable, "-m", "mine_tpu.serve.hostnet"]
+    warm_key, warm_seed = "00000001benchwarm", 11
+
+    build = subprocess.run(
+        hostnet + ["--host-id", "builder", "--build-artifact", artifact,
+                   "--cache-shards", "1", "--warm-key", warm_key,
+                   "--warm-seed", str(warm_seed)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+    assert build.returncode == 0, (
+        "serve_multihost: artifact build failed: %s"
+        % build.stderr[-300:])
+
+    procs, handles = {}, {}
+
+    def _cleanup():
+        for hid, p in procs.items():
+            if p.poll() is None:
+                try:
+                    handles[hid].drain()
+                except Exception:  # noqa: BLE001 - hard-kill fallback
+                    p.terminate()
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    try:
+        for i in range(max(counts)):
+            hid = "h%d" % i
+            p = subprocess.Popen(
+                hostnet + ["--host-id", hid, "--port", "0",
+                           "--aot-artifact", artifact,
+                           "--warm-key", warm_key,
+                           "--warm-seed", str(warm_seed),
+                           "--drain-timeout-s", "5"],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True, bufsize=1)
+            procs[hid] = p
+            fields = {}
+            while True:
+                line = p.stdout.readline()
+                if not line:
+                    break
+                fields = dict(kv.split("=", 1) for kv in line.split()
+                              if "=" in kv)
+                if fields.get("ready") == "1":
+                    break
+            assert fields.get("ready") == "1", (
+                "serve_multihost: host %s failed to boot" % hid)
+            assert int(fields.get("aot_compiles", -1)) == 0 and \
+                int(fields.get("aot_loads", 0)) > 0, (
+                "serve_multihost: host %s compiled live "
+                "(loads=%s compiles=%s)"
+                % (hid, fields.get("aot_loads"),
+                   fields.get("aot_compiles")))
+            handles[hid] = HostClient("127.0.0.1:%s" % fields["port"],
+                                      timeout_s=300.0)
+
+        pose = np.eye(4, dtype=np.float32)
+        keys = ["%08x" % ((s * 2 ** 32) // n_keys + 1) + "bench%d" % s
+                for s in range(n_keys)]
+        imgs = {k: np.full((8, 8, 3), 40.0 + i, np.float32)
+                for i, k in enumerate(keys)}
+
+        def flood(front, n):
+            import concurrent.futures as cf
+            t0 = time.perf_counter()
+            futs = [front.submit(keys[i % n_keys], pose,
+                                 image=imgs[keys[i % n_keys]])
+                    for i in range(n)]
+            cf.wait(futs, timeout=600)
+            dt = time.perf_counter() - t0
+            errs = [f for f in futs if f.exception() is not None]
+            assert not errs, (
+                "serve_multihost: %d flood requests failed: %r"
+                % (len(errs), errs[0].exception()))
+            return n / dt
+
+        def arm(H, drain_one=False):
+            ring = HostRing()
+            front = RingFront(ring, {})
+            for hid in list(handles)[:H]:
+                front.add_host(hid, handles[hid])
+            if drain_one:
+                # ring-side mark only: the process stays up for later
+                # arms; its range re-resolves ring-wise = pure failover
+                ring.drain("h0", emit=False)
+            try:
+                flood(front, max(n_req // 4, n_keys))  # routing warm-up
+                vps = flood(front, n_req)
+                return vps, front.remote_route_fraction()
+            finally:
+                front.close()
+
+        curve = [(H,) + arm(H) for H in counts]
+        fo_vps, fo_frac = arm(counts[-1], drain_one=True)
+
+        print("  serve_multihost curve: "
+              + " ".join("%d:%.3f:%.3f" % (H, vps, frac)
+                         for H, vps, frac in curve)
+              + " failover%d:%.3f:%.3f" % (counts[-1], fo_vps, fo_frac)
+              + "  (hosts:views_per_sec:remote_frac, %d req/arm)" % n_req,
+              file=sys.stderr)
+        from mine_tpu import telemetry
+        for H, vps, frac in curve:
+            telemetry.emit("serve.multihost_point", hosts=H,
+                           views_per_sec=round(vps, 3),
+                           remote_frac=round(frac, 4))
+
+        def run(n):
+            ring = HostRing()
+            front = RingFront(ring, {})
+            for hid in handles:
+                front.add_host(hid, handles[hid])
+            try:
+                return flood(front, n)
+            finally:
+                front.close()
+
+        if keep_run:
+            import atexit
+            atexit.register(_cleanup)  # hosts must outlive the closure
+        return curve[-1][1], None, (run if keep_run else None), 1
+    finally:
+        if not keep_run:
+            _cleanup()
+
+
 def _measure_ssim_ab(name, steps=MEASURE_STEPS, keep_run=False):
     """training.ssim_precision A/B (the ssim_precision_ab variants).
 
@@ -1338,6 +1512,9 @@ def _measure(name, steps=MEASURE_STEPS, keep_run=False):
                                         keep_run=keep_run)
     if name.startswith("stream_session"):
         return _measure_stream_session(name, steps=steps, keep_run=keep_run)
+    if name.startswith("serve_multihost"):
+        return _measure_serve_multihost(name, steps=steps,
+                                        keep_run=keep_run)
     if name.startswith("ssim_precision"):
         return _measure_ssim_ab(name, steps=steps, keep_run=keep_run)
     if name.startswith("pipepass"):

@@ -65,11 +65,27 @@ from typing import Dict, List, Optional
 #   registry/metric   registry lock creates metrics; metric locks nest never
 #   events state->sink  configure() closes the old sink under the state lock
 #                       — the one genuine nesting, hence state < sink
+#   hostnet.state     the host server's drain/inflight Condition
+#                     (serve/hostnet.py): handler threads hold it only for
+#                     counter flips and release BEFORE calling
+#                     fleet.submit; the drain path waits on it, releases,
+#                     then closes the fleet — so it sits below the whole
+#                     serve plane
+#   ring front / ring the multi-host route tallies and the ring membership
+#                     table (serve/ring.py): the front resolves the owner
+#                     under its tally lock by calling into the ring
+#                     (front < ring), both release before any host handle
+#                     call (which re-enters batcher.cv/fleet.cache on a
+#                     local host) — so both rank below batcher.cv; the
+#                     membership-change events nest ascending under ring
 LOCK_RANKS: Dict[str, int] = {
     "telemetry.recorder.dump": 2,
     "telemetry.recorder.state": 3,
     "serve.session.manager": 4,
     "serve.session": 5,
+    "serve.hostnet.state": 6,
+    "serve.ring.front": 7,
+    "serve.ring": 8,
     "serve.batcher.cv": 10,
     "serve.fleet.cache": 15,
     "telemetry.recorder.ring": 18,

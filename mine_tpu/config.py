@@ -369,6 +369,32 @@ class ServeConfig:
     # cache directly. "xla" (default) is byte-identical to the
     # pre-megakernel engine.
     warp_backend: str = "xla"
+    # serve.ring.*: multi-host elastic ring (serve/ring.py, serve/hostnet.py)
+    # — a front tier routes requests by content-hash key range to owner
+    # HOSTS (the fleet.shard_for_key discipline, one ring across the
+    # fleet), each host running today's ServeFleet as its local slice
+    # behind a stdlib HTTP/JSON transport. Disabled by default: ring-off
+    # is bitwise-identical to the single-process fleet.
+    ring_enabled: bool = False
+    # serve.ring.hosts: comma-separated host:port peers forming the ring
+    # (ring-slot order = list order); "" with ring enabled = a one-host
+    # ring of this process only
+    ring_hosts: str = ""
+    # serve.ring.drain_timeout_s: max seconds a SIGTERM'd/drained host
+    # waits for in-flight requests before closing anyway
+    ring_drain_timeout_s: float = 30.0
+    # serve.ring.autoscale.*: the pressure-driven host autoscaler
+    # (serve/ring.py Autoscaler). Pressure >= 1.0 for `evals` consecutive
+    # evaluations grows the fleet one host; pressure < hysteresis for
+    # `evals` consecutive evaluations shrinks it one host; cooldown_s of
+    # quiet follows every action — the admission ladder's stickiness, so
+    # it never oscillates. Off constructs nothing.
+    autoscale_enabled: bool = False
+    autoscale_min_hosts: int = 1
+    autoscale_max_hosts: int = 4
+    autoscale_evals: int = 3
+    autoscale_hysteresis: float = 0.5
+    autoscale_cooldown_s: float = 30.0
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -412,6 +438,18 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         session_probe_stride=int(g("serve.session.probe_stride", 4)),
         session_keyframe_tier=int(g("serve.session.keyframe_tier", 2)),
         warp_backend=str(g("serve.warp_backend", "xla")),
+        ring_enabled=bool(g("serve.ring.enabled", False)),
+        ring_hosts=str(g("serve.ring.hosts", "") or ""),
+        ring_drain_timeout_s=float(
+            g("serve.ring.drain_timeout_s", 30.0) or 0.0),
+        autoscale_enabled=bool(g("serve.ring.autoscale.enabled", False)),
+        autoscale_min_hosts=int(g("serve.ring.autoscale.min_hosts", 1)),
+        autoscale_max_hosts=int(g("serve.ring.autoscale.max_hosts", 4)),
+        autoscale_evals=int(g("serve.ring.autoscale.evals", 3)),
+        autoscale_hysteresis=float(
+            g("serve.ring.autoscale.hysteresis", 0.5)),
+        autoscale_cooldown_s=float(
+            g("serve.ring.autoscale.cooldown_s", 30.0) or 0.0),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -516,6 +554,35 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.session.keyframe_tier must be >= 0, "
             f"got {out.session_keyframe_tier}")
+    if out.ring_drain_timeout_s < 0:
+        raise ValueError(
+            f"serve.ring.drain_timeout_s must be >= 0, "
+            f"got {out.ring_drain_timeout_s}")
+    for host in (h.strip() for h in out.ring_hosts.split(",") if h.strip()):
+        # host:port peers; the split-off tail must be a port number
+        if ":" not in host or not host.rsplit(":", 1)[1].isdigit():
+            raise ValueError(
+                f"serve.ring.hosts entries must be host:port, got {host!r}")
+    if out.autoscale_min_hosts < 1:
+        raise ValueError(
+            f"serve.ring.autoscale.min_hosts must be >= 1, "
+            f"got {out.autoscale_min_hosts}")
+    if out.autoscale_max_hosts < out.autoscale_min_hosts:
+        raise ValueError(
+            f"serve.ring.autoscale.max_hosts must be >= min_hosts "
+            f"({out.autoscale_min_hosts}), got {out.autoscale_max_hosts}")
+    if out.autoscale_evals < 1:
+        raise ValueError(
+            f"serve.ring.autoscale.evals must be >= 1, "
+            f"got {out.autoscale_evals}")
+    if not 0.0 < out.autoscale_hysteresis < 1.0:
+        raise ValueError(
+            f"serve.ring.autoscale.hysteresis must be in (0, 1), "
+            f"got {out.autoscale_hysteresis}")
+    if out.autoscale_cooldown_s < 0:
+        raise ValueError(
+            f"serve.ring.autoscale.cooldown_s must be >= 0, "
+            f"got {out.autoscale_cooldown_s}")
     return out
 
 

@@ -17,6 +17,12 @@ asymmetry (README "Serving" / "Sharded serving"):
   session.py   StreamSession — keyframe-cadenced streaming video over the
                plane cache (shard-sticky ids, drift re-keying)
   stream.py    SessionManager — concurrent sessions through the batcher
+  ring.py      HostRing / RingFront / Autoscaler — the multi-HOST ring:
+               content-hash key ranges owned by hosts, each running a
+               ServeFleet as its local slice, with the pressure-driven
+               autoscaler (serve.ring.* keys, default off)
+  hostnet.py   HostServer / HostClient — stdlib HTTP/JSON host transport,
+               SIGTERM drain, subprocess host entrypoint
 
 Configured by the serve.* keys (configs/params_default.yaml,
 config.ServeConfig).
@@ -34,6 +40,9 @@ from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   quantize_planes)
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.serve.fleet import ServeFleet, ShardedPlaneCache, shard_for_key
+from mine_tpu.serve.hostnet import HostClient, HostServer
+from mine_tpu.serve.ring import (Autoscaler, HostRing, HostUnavailable,
+                                 LocalHost, RingFront, pressure_score)
 from mine_tpu.serve.session import (StreamSession, keyframe_id, probe_drift,
                                     relative_pose, session_key_prefix)
 from mine_tpu.serve.stream import SessionManager
@@ -42,14 +51,16 @@ from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
                                      render_shardings)
 
 __all__ = [
-    "AOTStore", "AdmissionController", "ContinuousBatcher",
-    "DeadlineExceeded", "MPICache", "MPIEntry", "MeshRenderEngine",
-    "MicroBatcher", "PyramidCache", "RenderEngine", "RequestShed",
-    "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS", "ServeFleet", "SessionManager",
-    "ShardedPlaneCache", "StreamSession", "TIER_BEST_EFFORT",
-    "TIER_CRITICAL", "TIER_STANDARD", "dequantize_planes",
-    "dequantize_weights", "env_fingerprint", "image_id_for", "keyframe_id",
-    "make_encode_fn", "make_serve_mesh", "pow2_bucket", "probe_drift",
-    "quantize_planes", "quantize_weights_int8", "relative_pose",
-    "render_shardings", "session_key_prefix", "shard_for_key",
+    "AOTStore", "AdmissionController", "Autoscaler", "ContinuousBatcher",
+    "DeadlineExceeded", "HostClient", "HostRing", "HostServer",
+    "HostUnavailable", "LocalHost", "MPICache", "MPIEntry",
+    "MeshRenderEngine", "MicroBatcher", "PyramidCache", "RenderEngine",
+    "RequestShed", "RingFront", "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS",
+    "ServeFleet", "SessionManager", "ShardedPlaneCache", "StreamSession",
+    "TIER_BEST_EFFORT", "TIER_CRITICAL", "TIER_STANDARD",
+    "dequantize_planes", "dequantize_weights", "env_fingerprint",
+    "image_id_for", "keyframe_id", "make_encode_fn", "make_serve_mesh",
+    "pow2_bucket", "pressure_score", "probe_drift", "quantize_planes",
+    "quantize_weights_int8", "relative_pose", "render_shardings",
+    "session_key_prefix", "shard_for_key",
 ]

@@ -250,3 +250,93 @@ class AOTStore:
             "load_errors": self.load_errors,
             "saves": self.saves, "save_errors": self.save_errors,
         }
+
+
+# ------------------------------------------------------------------ packing
+
+# manifest filename inside a packed artifact; carries the builder's
+# fingerprint so a deploy can see at a glance what environment it targets
+PACK_MANIFEST = "MANIFEST.json"
+
+
+def pack_store(root: str, out_path: str) -> Dict[str, Any]:
+    """Pack a store directory into ONE deployable tar artifact.
+
+    The archive is FLAT — artifact/sidecar basenames plus a MANIFEST.json
+    carrying the store schema, the builder's environment fingerprint and
+    the member list — written atomically (tmp + rename) in sorted member
+    order so identical stores pack byte-identically. Returns the manifest.
+    Only `ARTIFACT_EXT`/`SIDECAR_EXT` files are packed; anything else in
+    the directory is someone else's.
+    """
+    import io
+    import tarfile
+
+    store = AOTStore(root)
+    names = sorted(
+        f for f in os.listdir(store.root)
+        if f.endswith(ARTIFACT_EXT) or f.endswith(SIDECAR_EXT))
+    manifest = {
+        "schema": STORE_SCHEMA,
+        "fingerprint": env_fingerprint(),
+        "members": names,
+        "artifacts": sum(1 for f in names if f.endswith(ARTIFACT_EXT)),
+    }
+    out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".pack.tmp")
+    os.close(fd)
+    try:
+        with tarfile.open(tmp, "w") as tf:
+            blob = json.dumps(manifest, sort_keys=True,
+                              indent=1).encode("utf-8")
+            info = tarfile.TarInfo(PACK_MANIFEST)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+            for name in names:
+                tf.add(os.path.join(store.root, name), arcname=name)
+        os.replace(tmp, out_path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return manifest
+
+
+def unpack_store(artifact_path: str, root: str) -> Dict[str, Any]:
+    """Unpack a packed artifact into a store directory (created if
+    missing); returns the manifest. Member names are validated hard —
+    flat basenames with the store's extensions only, so a hostile or
+    corrupted archive can never write outside `root` — and each file is
+    written atomically so a half-unpacked store still just misses."""
+    import tarfile
+
+    os.makedirs(root, exist_ok=True)
+    manifest: Dict[str, Any] = {}
+    with tarfile.open(artifact_path, "r") as tf:
+        for m in tf.getmembers():
+            name = m.name
+            if not m.isfile() or name != os.path.basename(name) \
+                    or name.startswith("."):
+                raise ValueError(
+                    f"packed store member {name!r} is not a flat file")
+            if name == PACK_MANIFEST:
+                manifest = json.loads(tf.extractfile(m).read())
+                continue
+            if not (name.endswith(ARTIFACT_EXT)
+                    or name.endswith(SIDECAR_EXT)):
+                raise ValueError(
+                    f"packed store member {name!r} has a foreign extension")
+            fd, tmp = tempfile.mkstemp(dir=root, suffix=".unpack.tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(tf.extractfile(m).read())
+                os.replace(tmp, os.path.join(root, name))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    return manifest

@@ -1,0 +1,495 @@
+"""Host transport for the serving ring: stdlib HTTP/JSON host server +
+client, SIGTERM drain, and the subprocess host entrypoint.
+
+`HostServer` puts ONE ring host on the network: today's ServeFleet as the
+local slice behind a `ThreadingHTTPServer` (the exact telemetry/export.py
+OpsServer idiom — daemon thread, loopback default, port 0 = ephemeral, no
+new deps). The wire format is JSON with base64 float32 arrays, so a
+render round-trips BITWISE (tests/test_serve_ring.py pins HTTP == local):
+
+    POST /render   {"image_id", "pose": [16 row-major floats], "tier",
+                    "deadline_ms", "image": {shape,dtype,b64} | null}
+                -> {"ok": true, "rgb": {...}, "depth": {...}}
+                   or an error envelope {"ok": false, "kind", "error"}
+                   (429 shed, 504 deadline, 503 draining — the client
+                   re-raises the matching exception class, so admission
+                   semantics survive the wire)
+    GET  /healthz  fleet health + {"host", "state", "inflight"}
+    GET  /stats    fleet stats + AOT boot evidence (bucket_loads/compiles)
+    GET  /metrics  Prometheus text of this process's registry
+    POST /drain    begin draining (the programmatic SIGTERM)
+
+Preemption is ported serve-side from the train loop (train/resilience.py
+PreemptionHandler): SIGTERM/SIGINT only flips the sticky flag; a watcher
+thread then runs the drain — stop admitting (503), wait out the in-flight
+requests (bounded by drain_timeout_s), emit the authoritative
+`serve.host_drain` with the host's lifetime owner-hit/remote-route split,
+dump a flight-recorder incident bundle when a recorder is armed, and close
+the fleet. The key range hands back to the ring the moment any front
+observes the 503 (serve/ring.py re-resolves ring-wise).
+
+`main()` is the deployable unit's entrypoint: boot a host from a PACKED
+AOT artifact (tools/aot_warmstore.py --pack) with zero live compiles and
+serve until drained. Run `python -m mine_tpu.serve.hostnet --help`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from mine_tpu import telemetry
+from mine_tpu.analysis.locks import ordered_condition
+from mine_tpu.serve.admission import DeadlineExceeded, RequestShed
+from mine_tpu.serve.ring import HOST_ALIVE, HOST_DRAINING, HostUnavailable
+
+# synthetic-host geometry (--synthetic): matches tools/serve_chaos_soak.py
+# so the soak's keys/images render identically through subprocess hosts
+SYN_S, SYN_HW = 4, 8
+
+
+def pack_array(a: np.ndarray) -> Dict:
+    """numpy -> JSON-safe {shape, dtype, b64}; bytes survive verbatim."""
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def unpack_array(d: Dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+def synthetic_encode_fn(img_hwc):
+    """The soak's deterministic tiny encoder (image bytes -> fixed MPI),
+    shared here so subprocess hosts and in-parent builders produce
+    IDENTICAL programs and plane data — the cross-process bitwise and
+    zero-compile-join assertions depend on it."""
+    rng = np.random.RandomState(int(np.asarray(img_hwc).sum()) % 1000)
+    p = rng.uniform(-1, 1, (SYN_S, 4, SYN_HW, SYN_HW)).astype(np.float32)
+    return (p[:, 0:3], p[:, 3:4],
+            np.linspace(1.0, 0.2, SYN_S, dtype=np.float32),
+            np.eye(3, dtype=np.float32))
+
+
+# wire error envelope <-> exception class: the admission layer's verdicts
+# must survive the HTTP hop (a shed best-effort request on a remote host
+# is STILL a RequestShed to the front's caller, not a transport error)
+_KIND_STATUS = {"RequestShed": 429, "DeadlineExceeded": 504,
+                "HostUnavailable": 503}
+_KIND_RAISE = {"RequestShed": RequestShed,
+               "DeadlineExceeded": DeadlineExceeded,
+               "HostUnavailable": HostUnavailable}
+
+
+class HostServer:
+    """One ring host: a ServeFleet behind the stdlib HTTP/JSON transport.
+
+    Construct bound (port 0 = ephemeral; read `.port`), then `.start()`.
+    `drain()` is idempotent and runs the full hand-back sequence; the
+    `drained` event fires when it completes (main() exits on it).
+    """
+
+    def __init__(self, fleet, host_id: str, port: int = 0,
+                 host: str = "127.0.0.1", drain_timeout_s: float = 30.0,
+                 recorder=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.fleet = fleet
+        self.host_id = str(host_id)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.recorder = recorder
+        self.draining = False
+        self.inflight = 0
+        self.requests = 0
+        self.drained = threading.Event()
+        self._cv = ordered_condition("serve.hostnet.state")
+        srv = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, obj: Dict) -> None:
+                self._send(code, (json.dumps(obj) + "\n").encode())
+
+            def do_GET(self):  # noqa: N802 (stdlib handler API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send_json(200, srv.healthz())
+                    elif path == "/stats":
+                        self._send_json(200, srv.stats())
+                    elif path == "/metrics":
+                        from mine_tpu.telemetry.export import (
+                            CONTENT_TYPE, render_prometheus)
+                        self._send(200, render_prometheus().encode(),
+                                   CONTENT_TYPE)
+                    else:
+                        self._send_json(404, {"error": "not found"})
+                except BrokenPipeError:
+                    pass
+
+            def do_POST(self):  # noqa: N802 (stdlib handler API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if path == "/render":
+                        code, obj = srv._handle_render(body)
+                        self._send_json(code, obj)
+                    elif path == "/drain":
+                        # hand back asynchronously: the response must go
+                        # out before the fleet starts tearing down
+                        threading.Thread(target=srv.drain,
+                                         kwargs={"reason": "http"},
+                                         daemon=True).start()
+                        self._send_json(200, {"ok": True,
+                                              "host": srv.host_id})
+                    else:
+                        self._send_json(404, {"error": "not found"})
+                except BrokenPipeError:
+                    pass
+
+            def log_message(self, fmt, *args):  # silence request noise
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request path -----------------------------------------------------
+
+    def _handle_render(self, body: Dict):
+        with self._cv:
+            if self.draining:
+                return 503, {"ok": False, "kind": "HostUnavailable",
+                             "error": "draining"}
+            self.inflight += 1
+            self.requests += 1
+        try:
+            pose = np.asarray(body["pose"],
+                              np.float32).reshape(4, 4)
+            image = body.get("image")
+            rgb, depth = self.fleet.submit(
+                str(body["image_id"]), pose,
+                tier=body.get("tier"),
+                deadline_ms=body.get("deadline_ms"),
+                image=unpack_array(image) if image else None).result()
+            return 200, {"ok": True, "rgb": pack_array(rgb),
+                         "depth": pack_array(depth)}
+        except Exception as e:
+            kind = type(e).__name__
+            return (_KIND_STATUS.get(kind, 500),
+                    {"ok": False, "kind": kind, "error": str(e)})
+        finally:
+            with self._cv:
+                self.inflight -= 1
+                self._cv.notify_all()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "HostServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"mine-tpu-host-{self.host_id}")
+        self._thread.start()
+        return self
+
+    def drain(self, reason: str = "signal") -> None:
+        """The hand-back sequence; idempotent, safe from any thread."""
+        with self._cv:
+            if self.draining:
+                return
+            self.draining = True
+            deadline = time.monotonic() + self.drain_timeout_s
+            while self.inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(timeout=min(left, 0.5))
+            leftover = self.inflight
+        cache = getattr(self.fleet, "cache", None)
+        telemetry.emit(
+            "serve.host_drain", host=self.host_id, hosts=0,
+            inflight=leftover, reason=reason,
+            owner_hits=getattr(cache, "owner_hits", 0),
+            remote_routes=getattr(cache, "remote_routes", 0))
+        if self.recorder is not None:
+            try:
+                self.recorder.trigger("host_drain", force=True, sync=True,
+                                      host=self.host_id, reason=reason,
+                                      inflight=leftover)
+            except Exception:
+                pass  # the bundle is evidence, not a drain dependency
+        self.close()
+        self.fleet.close()
+        self.drained.set()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- introspection ----------------------------------------------------
+
+    def healthz(self) -> Dict:
+        out = dict(self.fleet.health())
+        with self._cv:
+            out.update(host=self.host_id,
+                       state=HOST_DRAINING if self.draining
+                       else HOST_ALIVE,
+                       inflight=self.inflight)
+        return out
+
+    def stats(self) -> Dict:
+        out = dict(self.fleet.stats())
+        engine = getattr(self.fleet, "engine", None)
+        with self._cv:
+            out.update(host=self.host_id, requests=self.requests,
+                       inflight=self.inflight, draining=self.draining,
+                       bucket_loads=getattr(engine, "bucket_loads", 0),
+                       bucket_compiles=getattr(engine, "bucket_compiles",
+                                               0))
+        return out
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def install_drain_signals(server: HostServer):
+    """Port of the train loop's preemption machinery: SIGTERM/SIGINT flip
+    the handler's sticky flag (no I/O in the handler — resilience.py
+    discipline), and a watcher thread runs the drain outside signal
+    context. Returns the PreemptionHandler (uninstall() to restore)."""
+    from mine_tpu.train.resilience import PreemptionHandler
+
+    handler = PreemptionHandler().install()
+
+    def _watch():
+        while not handler.requested and not server.drained.is_set():
+            time.sleep(0.05)
+        if handler.requested:
+            server.drain(reason="preempt")
+
+    threading.Thread(target=_watch, daemon=True,
+                     name=f"mine-tpu-drain-watch-{server.host_id}").start()
+    return handler
+
+
+class HostClient:
+    """Stdlib HTTP client half of the transport; satisfies the RingFront
+    handle protocol (render/healthz/stats/close). One connection per call
+    — thread-safe without pooling, and the ring's request rate is bounded
+    by render time, not connection setup."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        host, port = address.rsplit(":", 1)
+        self.host = host
+        self.port = int(port)
+        self.address = address
+        self.timeout_s = float(timeout_s)
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict] = None):
+        import http.client
+
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            payload = json.dumps(body).encode() if body is not None \
+                else None
+            conn.request(method, path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def render(self, image_id, pose, tier=None, deadline_ms=None,
+               image=None):
+        body = {"image_id": str(image_id),
+                "pose": np.asarray(pose, np.float32).reshape(-1).tolist(),
+                "tier": tier, "deadline_ms": deadline_ms,
+                "image": pack_array(np.asarray(image, np.float32))
+                if image is not None else None}
+        status, obj = self._request("POST", "/render", body)
+        if status == 200 and obj.get("ok"):
+            return unpack_array(obj["rgb"]), unpack_array(obj["depth"])
+        kind = obj.get("kind", "")
+        exc = _KIND_RAISE.get(kind, RuntimeError)
+        raise exc(f"{self.address}: {obj.get('error', f'HTTP {status}')}")
+
+    def healthz(self) -> Dict:
+        return self._request("GET", "/healthz")[1]
+
+    def stats(self) -> Dict:
+        return self._request("GET", "/stats")[1]
+
+    def drain(self) -> Dict:
+        return self._request("POST", "/drain", {})[1]
+
+    def close(self) -> None:
+        pass  # connections are per-call; nothing is held
+
+
+def _entries_counts(limit: int):
+    """Every pow2 entries bucket the batcher can form (<= max_requests):
+    the warmup set a host must cover so a concurrent flood — which
+    coalesces distinct cache entries into R>1 dispatch batches — never
+    triggers a live compile after a zero-compile join."""
+    out, b = [], 1
+    while b <= limit:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _build_fleet(args, encode_fn, recorder=None):
+    from mine_tpu.serve import ServeFleet
+
+    return ServeFleet(
+        cache_shards=args.cache_shards, max_requests=args.max_requests,
+        max_wait_ms=2.0, max_bucket=args.max_bucket, encode_fn=encode_fn,
+        slo_objective_ms=args.slo_objective_ms, ops_port=None,
+        encode_retries=3, encode_backoff_ms=5.0,
+        admission_enabled=args.admission,
+        admission_burn_max=0.0, admission_queue_high=args.queue_high,
+        admission_inflight_high=0, aot_store_dir=args.aot_store,
+        recorder=recorder)
+
+
+def main(argv=None) -> int:
+    """Subprocess host entrypoint (see module docstring). Every line of
+    stdout is "key=value ..."-parseable; the spawner reads the `ready=1`
+    line for the bound port and the zero-compile-join evidence."""
+    import argparse
+    import os
+    import tempfile
+
+    ap = argparse.ArgumentParser(
+        description="mine-tpu serving ring host (stdlib HTTP/JSON)")
+    ap.add_argument("--host-id", type=str, required=True)
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral; the bound port is printed")
+    ap.add_argument("--cache-shards", type=int, default=2)
+    ap.add_argument("--max-bucket", type=int, default=2)
+    ap.add_argument("--max-requests", type=int, default=8)
+    ap.add_argument("--slo-objective-ms", type=float, default=0.0)
+    ap.add_argument("--admission", action="store_true",
+                    help="enable the local admission ladder")
+    ap.add_argument("--queue-high", type=int, default=64)
+    ap.add_argument("--aot-store", type=str, default="",
+                    help="AOT executable store directory")
+    ap.add_argument("--aot-artifact", type=str, default="",
+                    help="packed artifact (aot_warmstore.py --pack); "
+                         "unpacked to a fresh store dir before boot")
+    ap.add_argument("--warm-key", type=str, default="",
+                    help="image id to put+warmup at boot — the warmup is "
+                         "what records the AOT loads/compiles evidence")
+    ap.add_argument("--warm-seed", type=int, default=0,
+                    help="synthetic image seed for --warm-key")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0)
+    ap.add_argument("--incidents-dir", type=str, default="",
+                    help="arm a flight recorder; drains dump a bundle")
+    ap.add_argument("--build-artifact", type=str, default="",
+                    help="builder mode: boot the same fleet, warm every "
+                         "bucket, pack the store to this path, exit — "
+                         "the artifact hosts then boot from is guaranteed "
+                         "program-key-compatible")
+    args = ap.parse_args(argv)
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from mine_tpu.serve import aot as serve_aot
+
+    if args.build_artifact:
+        store_dir = args.aot_store or tempfile.mkdtemp(
+            prefix=f"host_{args.host_id}_build_")
+        args.aot_store = store_dir
+        fleet = _build_fleet(args, synthetic_encode_fn)
+        img = np.full((SYN_HW, SYN_HW, 3), float(args.warm_seed),
+                      np.float32)
+        key = args.warm_key or "builder"
+        fleet.engine.put(key, *synthetic_encode_fn(img))
+        fleet.warmup(key,
+                     entries_counts=_entries_counts(args.max_requests))
+        compiles = fleet.engine.bucket_compiles
+        loads = fleet.engine.bucket_loads
+        fleet.close()
+        manifest = serve_aot.pack_store(store_dir, args.build_artifact)
+        print(f"host={args.host_id} built=1 compiles={compiles} "
+              f"loads={loads} packed={manifest['artifacts']} "
+              f"artifact={args.build_artifact}", flush=True)
+        return 0
+
+    if args.aot_artifact:
+        # the packed artifact is the deployable unit: unpack to a private
+        # store dir so concurrent hosts never share write paths
+        store_dir = tempfile.mkdtemp(prefix=f"host_{args.host_id}_aot_")
+        serve_aot.unpack_store(args.aot_artifact, store_dir)
+        args.aot_store = store_dir
+        print(f"host={args.host_id} unpacked_store={store_dir}",
+              flush=True)
+
+    recorder = None
+    if args.incidents_dir:
+        from mine_tpu.telemetry import recorder as trecorder
+
+        recorder = trecorder.configure(
+            args.incidents_dir, debounce_s=1.0, keep=8,
+            config={"host": args.host_id})
+
+    fleet = _build_fleet(args, synthetic_encode_fn, recorder=recorder)
+    loads = compiles = 0
+    if args.warm_key:
+        img = np.full((SYN_HW, SYN_HW, 3), float(args.warm_seed),
+                      np.float32)
+        fleet.engine.put(args.warm_key, *synthetic_encode_fn(img))
+        fleet.warmup(args.warm_key,
+                     entries_counts=_entries_counts(args.max_requests))
+        loads = fleet.engine.bucket_loads
+        compiles = fleet.engine.bucket_compiles
+
+    server = HostServer(fleet, args.host_id, port=args.port,
+                        drain_timeout_s=args.drain_timeout_s,
+                        recorder=recorder).start()
+    handler = install_drain_signals(server)
+    telemetry.emit("serve.host_join", host=args.host_id, hosts=1,
+                   aot_loads=loads, aot_compiles=compiles)
+    print(f"host={args.host_id} port={server.port} ready=1 "
+          f"aot_loads={loads} aot_compiles={compiles} pid={os.getpid()}",
+          flush=True)
+
+    server.drained.wait()
+    handler.uninstall()
+    if recorder is not None:
+        from mine_tpu.telemetry import recorder as trecorder
+
+        trecorder.release(recorder)
+    print(f"host={args.host_id} drained=1", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,555 @@
+"""Multi-host elastic serving ring: host membership + front routing + the
+pressure-driven autoscaler.
+
+Everything the fleet scales so far — mesh render, key-range cache shards,
+failover, the AOT warm store — lives inside ONE process; this module is the
+step out of it. Three pieces, mirroring the single-process fleet one level
+up:
+
+  * `HostRing` — ONE consistent ring across the fleet: the content-hash
+    key space (the exact `shard_for_key` discipline from serve/fleet.py)
+    is cut into `len(hosts)` contiguous ranges and each range is owned by
+    a HOST. Ownership is a pure function of (image_id, member list,
+    state map) — any front routes identically with no routing table to
+    distribute — and a key whose slot owner is draining/dead resolves
+    ring-wise to the next alive member, so every key is owned by exactly
+    one alive host at all times (tests/test_serve_ring.py pins the
+    covering/contiguity property). Membership edges emit the pinned
+    `serve.host_join` / `serve.host_drain` / `serve.ring_rebalance`
+    events.
+  * `RingFront` — the routing front: resolves the owner host per request,
+    calls its handle (a `LocalHost` wrapping an in-process ServeFleet, or
+    a `hostnet.HostClient` over the stdlib HTTP/JSON transport), and
+    fails over ring-wise when a host refuses (draining) or disconnects —
+    marking the member so subsequent requests route past it. Counts
+    owner-hits vs remote-routes per host (`serve.ring.*` counters), the
+    signal the autoscaler and obs_report consume.
+  * `Autoscaler` — the first real closed loop: grow/shrink the host count
+    (and, through the actuator callbacks, `cache_shards` via the existing
+    `rebalance(n)` / `revive_shard`) from the admission pressure score,
+    the remote-route fraction and the SLO error-budget burn. Decisions
+    use the admission ladder's stickiness (serve/admission.py): act only
+    after `evals` CONSECUTIVE evaluations agree, shrink only when
+    pressure falls below `hysteresis` (a deadband between the grow and
+    shrink thresholds), and hold a cooldown after every action — so the
+    `serve.autoscale` trail never oscillates.
+
+Ring-off constructs none of this: `ServeFleet` is untouched and
+bitwise-identical to the single-process path (test-pinned).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from mine_tpu import telemetry
+from mine_tpu.analysis.locks import ordered_lock
+from mine_tpu.serve.fleet import shard_for_key
+
+_METRIC_PREFIX = "serve.ring"
+
+HOST_ALIVE = "alive"
+HOST_DRAINING = "draining"
+HOST_DEAD = "dead"
+HOST_STATES = (HOST_ALIVE, HOST_DRAINING, HOST_DEAD)
+
+
+class HostUnavailable(RuntimeError):
+    """A host handle refused the request (draining) or is unreachable.
+
+    The front treats this as a routing fact, not a request failure: the
+    member is marked and the request re-resolves ring-wise."""
+
+
+class HostRing:
+    """Consistent key-range ring over named hosts.
+
+    Slot order is join order; slot s of N owns key range
+    [s*2^32/N, (s+1)*2^32/N) via `shard_for_key` — the same pure-function
+    discipline as the in-process cache shards, one level up. A non-alive
+    slot owner resolves ring-wise to the next alive member (the
+    `ShardedPlaneCache._alive_owner` walk), so the alive set always covers
+    the whole key space. Membership/state transitions that re-cut
+    effective ownership emit `serve.ring_rebalance`; joins and drains emit
+    their pinned events. All membership state sits under one rank-ordered
+    lock ("serve.ring") so fronts, the autoscaler and drain handlers can
+    race.
+    """
+
+    def __init__(self) -> None:
+        self._members: List[str] = []   # ring slot order = join order
+        self._state: Dict[str, str] = {}
+        self._lock = ordered_lock("serve.ring")
+        self.rebalances = 0
+
+    # -- membership -------------------------------------------------------
+
+    def join(self, host: str, aot_loads: int = 0,
+             aot_compiles: int = 0) -> None:
+        """Add `host` as alive (or revive a known member). Emits
+        `serve.host_join` carrying the zero-compile-join evidence and a
+        `serve.ring_rebalance` for the re-cut key ranges."""
+        if not host:
+            raise ValueError("host id must be non-empty")
+        with self._lock:
+            before = self._alive_count_locked()
+            if host not in self._state:
+                self._members.append(host)
+            elif self._state[host] == HOST_ALIVE:
+                return  # idempotent re-join: nothing changed, no events
+            self._state[host] = HOST_ALIVE
+            after = self._alive_count_locked()
+            self._set_gauges_locked()
+        telemetry.emit("serve.host_join", host=host, hosts=after,
+                       aot_loads=int(aot_loads),
+                       aot_compiles=int(aot_compiles))
+        telemetry.counter(f"{_METRIC_PREFIX}.host_joins").inc()
+        self._emit_rebalance(before, after)
+
+    def drain(self, host: str, inflight: int = 0, emit: bool = True,
+              **extra) -> None:
+        """Mark `host` draining: it keeps its slot but stops owning keys
+        (its range resolves ring-wise past it). `extra` rides on the
+        `serve.host_drain` event — hosts report their lifetime
+        owner_hits/remote_routes here for the obs_report split. A front
+        that merely OBSERVES a remote drain (the host emitted its own
+        authoritative event already) passes emit=False; the
+        ring_rebalance for the re-cut ranges always fires."""
+        with self._lock:
+            if self._state.get(host) != HOST_ALIVE:
+                return
+            before = self._alive_count_locked()
+            self._state[host] = HOST_DRAINING
+            after = self._alive_count_locked()
+            self._set_gauges_locked()
+        if emit:
+            telemetry.emit("serve.host_drain", host=host, hosts=after,
+                           inflight=int(inflight), **extra)
+        telemetry.counter(f"{_METRIC_PREFIX}.host_drains").inc()
+        self._emit_rebalance(before, after)
+
+    def mark_dead(self, host: str) -> None:
+        """A host vanished without draining (connection refused/reset)."""
+        with self._lock:
+            if host not in self._state or self._state[host] == HOST_DEAD:
+                return
+            before = self._alive_count_locked()
+            self._state[host] = HOST_DEAD
+            after = self._alive_count_locked()
+            self._set_gauges_locked()
+        telemetry.counter(f"{_METRIC_PREFIX}.host_deaths").inc()
+        self._emit_rebalance(before, after)
+
+    def remove(self, host: str) -> None:
+        """Drop a drained/dead member's slot entirely (ranges re-cut)."""
+        with self._lock:
+            if host not in self._state:
+                return
+            before = self._alive_count_locked()
+            self._members.remove(host)
+            del self._state[host]
+            after = self._alive_count_locked()
+            self._set_gauges_locked()
+        self._emit_rebalance(before, after, force=True)
+
+    # -- ownership --------------------------------------------------------
+
+    def owner(self, image_id: str) -> str:
+        """The unique alive owner of `image_id`: its slot owner, or —
+        when that member is draining/dead — the next alive member
+        ring-wise. Deterministic in (id, member list, state map)."""
+        with self._lock:
+            return self._owner_locked(image_id)
+
+    def slot_owner(self, image_id: str) -> str:
+        """The member whose RANGE contains the key, alive or not (what
+        the front compares against to count owner-hit vs remote-route)."""
+        with self._lock:
+            if not self._members:
+                raise HostUnavailable("ring has no members")
+            return self._members[shard_for_key(image_id,
+                                               len(self._members))]
+
+    def _owner_locked(self, image_id: str) -> str:
+        n = len(self._members)
+        if n == 0:
+            raise HostUnavailable("ring has no members")
+        o = shard_for_key(image_id, n)
+        for step in range(n):
+            cand = self._members[(o + step) % n]
+            if self._state[cand] == HOST_ALIVE:
+                return cand
+        raise HostUnavailable("ring has no alive hosts")
+
+    # -- introspection ----------------------------------------------------
+
+    def members(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return [(h, self._state[h]) for h in self._members]
+
+    def alive(self) -> List[str]:
+        with self._lock:
+            return [h for h in self._members
+                    if self._state[h] == HOST_ALIVE]
+
+    def state(self, host: str) -> Optional[str]:
+        with self._lock:
+            return self._state.get(host)
+
+    def coverage(self) -> float:
+        """Fraction of ring slots owned DIRECTLY by an alive member (1.0 =
+        no key is riding a failover hop). Every key remains covered while
+        any member is alive — this gauges how much of the space is."""
+        with self._lock:
+            if not self._members:
+                return 0.0
+            alive = self._alive_count_locked()
+            return alive / len(self._members)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            states = dict(self._state)
+            members = list(self._members)
+        alive = [h for h in members if states[h] == HOST_ALIVE]
+        draining = [h for h in members if states[h] == HOST_DRAINING]
+        dead = [h for h in members if states[h] == HOST_DEAD]
+        return {
+            "hosts": len(members),
+            "alive": alive,
+            "draining": draining,
+            "dead": dead,
+            "coverage": (len(alive) / len(members)) if members else 0.0,
+            "rebalances": self.rebalances,
+        }
+
+    # -- internals --------------------------------------------------------
+
+    def _alive_count_locked(self) -> int:
+        return sum(1 for h in self._members
+                   if self._state[h] == HOST_ALIVE)
+
+    def _set_gauges_locked(self) -> None:
+        telemetry.gauge(f"{_METRIC_PREFIX}.hosts_total").set(
+            len(self._members))
+        telemetry.gauge(f"{_METRIC_PREFIX}.hosts_alive").set(
+            self._alive_count_locked())
+        telemetry.gauge(f"{_METRIC_PREFIX}.hosts_draining").set(
+            sum(1 for h in self._members
+                if self._state[h] == HOST_DRAINING))
+
+    def _emit_rebalance(self, before: int, after: int,
+                        force: bool = False, **extra) -> None:
+        if before == after and not force:
+            return
+        self.rebalances += 1
+        telemetry.emit("serve.ring_rebalance", from_hosts=before,
+                       to_hosts=after, **extra)
+        telemetry.counter(f"{_METRIC_PREFIX}.rebalances").inc()
+
+
+class LocalHost:
+    """In-process host handle: today's ServeFleet as this host's slice.
+
+    The degenerate one-host ring routes every request here; a RingFront
+    over a single LocalHost is bitwise-identical to calling the fleet
+    directly (test-pinned), which is what makes ring-off a pure subset."""
+
+    def __init__(self, fleet) -> None:
+        self.fleet = fleet
+        self.draining = False
+
+    def render(self, image_id, pose, tier=None, deadline_ms=None,
+               image=None):
+        if self.draining:
+            raise HostUnavailable("host draining")
+        return self.fleet.submit(image_id, pose, tier=tier,
+                                 deadline_ms=deadline_ms,
+                                 image=image).result()
+
+    def healthz(self) -> Dict:
+        out = dict(self.fleet.health())
+        out["state"] = HOST_DRAINING if self.draining else HOST_ALIVE
+        return out
+
+    def stats(self) -> Dict:
+        return self.fleet.stats()
+
+    def close(self) -> None:
+        self.fleet.close()
+
+
+class RingFront:
+    """Content-hash routing front over the host ring.
+
+    `submit` resolves the alive owner, dispatches the request to its
+    handle on a worker pool, and — when the host turns out to be draining
+    or unreachable — marks the member in the ring and re-resolves, walking
+    ring-wise until an alive host answers or none remain. Requests may
+    carry the source image so a failover host can sync-encode a key it
+    never owned; that is what keeps critical traffic at zero failures
+    through a host SIGTERM (tools/serve_chaos_soak.py host-kill phase).
+    """
+
+    def __init__(self, ring: HostRing, handles: Dict[str, object],
+                 workers: int = 8) -> None:
+        self.ring = ring
+        self.handles = dict(handles)
+        self.owner_routes = 0
+        self.remote_routes = 0
+        self.reroutes = 0
+        self.failures = 0
+        self._per_host: Dict[str, List[int]] = {}  # host -> [owner, remote]
+        self._lock = ordered_lock("serve.ring.front")
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="ring-front")
+
+    def add_host(self, host: str, handle, aot_loads: int = 0,
+                 aot_compiles: int = 0) -> None:
+        with self._lock:
+            self.handles[host] = handle
+        self.ring.join(host, aot_loads=aot_loads,
+                       aot_compiles=aot_compiles)
+
+    def submit(self, image_id: str, pose, tier=None, deadline_ms=None,
+               image=None) -> "concurrent.futures.Future":
+        return self._pool.submit(self._route_one, image_id, pose, tier,
+                                 deadline_ms, image)
+
+    def render(self, image_id: str, pose, tier=None, deadline_ms=None,
+               image=None):
+        return self._route_one(image_id, pose, tier, deadline_ms, image)
+
+    def _route_one(self, image_id, pose, tier, deadline_ms, image):
+        slot_owner = self.ring.slot_owner(image_id)
+        last_err: Optional[Exception] = None
+        tried: set = set()
+        # at most one attempt per member: each failure marks the member,
+        # so the next resolve walks past it — bounded, never cycles
+        for _ in range(len(self.ring.members())):
+            try:
+                host = self.ring.owner(image_id)
+            except HostUnavailable as e:
+                last_err = e
+                break
+            if host in tried:  # owner didn't advance: nothing left to try
+                break
+            tried.add(host)
+            with self._lock:
+                handle = self.handles.get(host)
+            if handle is None:
+                self.ring.mark_dead(host)
+                continue
+            try:
+                out = handle.render(image_id, pose, tier=tier,
+                                    deadline_ms=deadline_ms, image=image)
+            except HostUnavailable as e:
+                last_err = e
+                self.ring.drain(host, emit=False)
+                self._count_reroute()
+                continue
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self.ring.mark_dead(host)
+                self._count_reroute()
+                continue
+            self._count_route(host, host == slot_owner)
+            return out
+        with self._lock:
+            self.failures += 1
+        telemetry.counter(f"{_METRIC_PREFIX}.failures").inc()
+        raise last_err if last_err is not None else HostUnavailable(
+            "no host served %r" % image_id)
+
+    def _count_route(self, host: str, is_owner: bool) -> None:
+        with self._lock:
+            tally = self._per_host.setdefault(host, [0, 0])
+            if is_owner:
+                self.owner_routes += 1
+                tally[0] += 1
+            else:
+                self.remote_routes += 1
+                tally[1] += 1
+        name = "owner_route" if is_owner else "remote_route"
+        telemetry.counter(f"{_METRIC_PREFIX}.{name}").inc()
+
+    def _count_reroute(self) -> None:
+        with self._lock:
+            self.reroutes += 1
+        telemetry.counter(f"{_METRIC_PREFIX}.reroutes").inc()
+
+    def remote_route_fraction(self) -> float:
+        with self._lock:
+            total = self.owner_routes + self.remote_routes
+            return (self.remote_routes / total) if total else 0.0
+
+    def route_split(self) -> Dict[str, List[int]]:
+        """Per-host [owner_hits, remote_routes] ledger (obs_report's
+        "fleet hosts" split; rides serve.ring_rebalance as `routes`)."""
+        with self._lock:
+            return {h: list(v) for h, v in self._per_host.items()}
+
+    def stats(self) -> Dict:
+        with self._lock:
+            out = {
+                "owner_routes": self.owner_routes,
+                "remote_routes": self.remote_routes,
+                "reroutes": self.reroutes,
+                "failures": self.failures,
+                "per_host": {h: list(v) for h, v in self._per_host.items()},
+            }
+        out["ring"] = self.ring.stats()
+        return out
+
+    def health(self) -> Dict:
+        ring = self.ring.stats()
+        return {
+            "status": "ok" if ring["alive"] else "down",
+            "ring": ring,
+        }
+
+    def close(self) -> None:
+        # the front's final route ledger, attached to one last rebalance
+        # record so postmortems see the split without scraping counters
+        alive = len(self.ring.alive())
+        self.ring._emit_rebalance(alive, alive, force=True,
+                                  routes=self.route_split())
+        self._pool.shutdown(wait=True)
+        with self._lock:
+            handles = list(self.handles.values())
+            self.handles.clear()
+        for handle in handles:
+            close = getattr(handle, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass  # teardown best-effort: a dead host can't close
+
+
+def pressure_score(*, admission: float = 0.0, burn: float = 0.0,
+                   burn_max: float = 1.0, remote_frac: float = 0.0,
+                   remote_high: float = 0.5) -> float:
+    """The autoscaler's composite pressure: max over normalized signals,
+    exactly the AdmissionController.score() shape — admission's own score
+    is already normalized (1.0 = at threshold), burn and remote-route
+    fraction normalize against their thresholds, and a threshold <= 0
+    disables its signal."""
+    score = float(admission)
+    if burn_max > 0:
+        score = max(score, float(burn) / burn_max)
+    if remote_high > 0:
+        score = max(score, float(remote_frac) / remote_high)
+    return score
+
+
+class Autoscaler:
+    """Hysteretic grow/shrink controller over the host ring.
+
+    `evaluate()` folds one pressure reading (score_fn) into the decision
+    state: `evals` CONSECUTIVE readings >= 1.0 grow by one host (up to
+    max_hosts), `evals` CONSECUTIVE readings < `hysteresis` shrink by one
+    (down to min_hosts), readings inside the [hysteresis, 1.0) deadband
+    reset both streaks, and every action opens a `cooldown_s` window in
+    which nothing fires — the admission ladder's stickiness, so the
+    `serve.autoscale` trail can never show grow/shrink flapping. Actions
+    call the injected actuators (grow_fn/shrink_fn receive the new target
+    host count); the soak's actuators spawn/drain subprocess hosts and
+    re-cut the local `cache_shards` via the existing `rebalance(n)`.
+    """
+
+    GROW_AT = 1.0  # pressure score meaning "at capacity" (normalized)
+
+    def __init__(self, *, min_hosts: int = 1, max_hosts: int = 4,
+                 evals: int = 3, hysteresis: float = 0.5,
+                 cooldown_s: float = 30.0,
+                 score_fn: Callable[[], float],
+                 hosts_fn: Callable[[], int],
+                 grow_fn: Optional[Callable[[int], None]] = None,
+                 shrink_fn: Optional[Callable[[int], None]] = None,
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
+        if min_hosts < 1:
+            raise ValueError(f"min_hosts must be >= 1, got {min_hosts}")
+        if max_hosts < min_hosts:
+            raise ValueError(
+                f"max_hosts must be >= min_hosts, got {max_hosts}")
+        if evals < 1:
+            raise ValueError(f"evals must be >= 1, got {evals}")
+        if not 0.0 < hysteresis < self.GROW_AT:
+            raise ValueError(
+                f"hysteresis must be in (0, 1), got {hysteresis}")
+        self.min_hosts = int(min_hosts)
+        self.max_hosts = int(max_hosts)
+        self.evals = int(evals)
+        self.hysteresis = float(hysteresis)
+        self.cooldown_s = float(cooldown_s)
+        self.score_fn = score_fn
+        self.hosts_fn = hosts_fn
+        self.grow_fn = grow_fn
+        self.shrink_fn = shrink_fn
+        self.now_fn = now_fn
+        self.decisions = 0
+        self.last_score = 0.0
+        self._high_streak = 0
+        self._low_streak = 0
+        self._cooldown_until = float("-inf")
+        telemetry.gauge(f"{_METRIC_PREFIX}.autoscale_level").set(
+            self.hosts_fn())
+
+    @property
+    def level(self) -> int:
+        return self.hosts_fn()
+
+    def evaluate(self) -> Optional[str]:
+        """One control tick; returns "grow"/"shrink" when it acted."""
+        score = float(self.score_fn())
+        self.last_score = score
+        if score >= self.GROW_AT:
+            self._high_streak += 1
+            self._low_streak = 0
+        elif score < self.hysteresis:
+            self._low_streak += 1
+            self._high_streak = 0
+        else:  # deadband: pressure is neither high nor low — hold
+            self._high_streak = 0
+            self._low_streak = 0
+        if self.now_fn() < self._cooldown_until:
+            return None
+        hosts = self.hosts_fn()
+        if self._high_streak >= self.evals and hosts < self.max_hosts:
+            return self._act("grow", hosts, hosts + 1, score,
+                             self.grow_fn)
+        if self._low_streak >= self.evals and hosts > self.min_hosts:
+            return self._act("shrink", hosts, hosts - 1, score,
+                             self.shrink_fn)
+        return None
+
+    def _act(self, action: str, from_hosts: int, to_hosts: int,
+             score: float, actuator) -> str:
+        # event BEFORE the actuator: the decision is the fact being
+        # pinned; the actuator (spawn/drain a host) may take seconds
+        telemetry.emit("serve.autoscale", action=action,
+                       from_hosts=from_hosts, to_hosts=to_hosts,
+                       score=round(score, 4))
+        telemetry.counter(f"{_METRIC_PREFIX}.autoscale_{action}").inc()
+        telemetry.gauge(f"{_METRIC_PREFIX}.autoscale_level").set(to_hosts)
+        self.decisions += 1
+        self._high_streak = 0
+        self._low_streak = 0
+        self._cooldown_until = self.now_fn() + self.cooldown_s
+        if actuator is not None:
+            actuator(to_hosts)
+        return action
+
+    def stats(self) -> Dict:
+        return {
+            "level": self.hosts_fn(),
+            "min_hosts": self.min_hosts,
+            "max_hosts": self.max_hosts,
+            "decisions": self.decisions,
+            "last_score": self.last_score,
+            "high_streak": self._high_streak,
+            "low_streak": self._low_streak,
+            "cooling": self.now_fn() < self._cooldown_until,
+        }

@@ -278,6 +278,24 @@ KIND_FIELDS: Dict[str, tuple] = {
     "serve.session_end": ("session", "frames", "keyframes"),
     "serve.stream_point": ("knee_cadence", "knee_fps", "n_frames"),
     "obs.incident": ("reason", "bundle"),
+    # multi-host ring (serve/ring.py, serve/hostnet.py; mtpu-ev1
+    # append-only). host = the joining/draining member's id; hosts = the
+    # alive count AFTER the transition as the emitter knows it (0 = the
+    # emitter — a standalone draining host — has no ring view). host_join
+    # pins the zero-compile-join evidence (AOT bucket loads vs live
+    # compiles at boot); host_drain may additionally carry the host's
+    # lifetime owner_hits/remote_routes.
+    "serve.host_join": ("host", "hosts", "aot_loads", "aot_compiles"),
+    "serve.host_drain": ("host", "hosts", "inflight"),
+    # membership change re-cutting key ranges (the host-level analogue of
+    # serve.shard.rebalance); may carry a "routes" per-host split dict
+    "serve.ring_rebalance": ("from_hosts", "to_hosts"),
+    # one event per autoscaler DECISION (grow|shrink), edge-triggered like
+    # serve.admission — a hysteretic trail never shows grow/shrink flapping
+    "serve.autoscale": ("action", "from_hosts", "to_hosts", "score"),
+    # one point per serve_multihost bench arm (bench.py): ring size vs
+    # aggregate throughput and the front's remote-route fraction
+    "serve.multihost_point": ("hosts", "views_per_sec", "remote_frac"),
 }
 
 

@@ -80,6 +80,10 @@ TRIGGER_KINDS: Dict[str, Optional[Callable[[Dict], bool]]] = {
     "train.guard_abort": None,
     "serve.admission": lambda f: f.get("state") == "shed",
     "serve.session_frame": lambda f: f.get("ok") is False,
+    # a host leaving the ring (preemption/SIGTERM) is always postmortem-
+    # worthy: the bundle captures the drain, the re-covered key range and
+    # whatever pressure preceded it
+    "serve.host_drain": None,
 }
 
 

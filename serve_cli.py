@@ -205,6 +205,57 @@ def main():
         logger.info("ops endpoint: %s (/metrics /healthz /slo "
                     "/traces/recent)", ops.url)
 
+    # multi-host ring view (serve.ring.* keys, default off): this process
+    # joins a HostRing as one member and probes its serve.ring.hosts peers
+    # once over the hostnet transport, so /healthz, /metrics and the exit
+    # stats line surface real ring state (hosts alive/draining, coverage,
+    # autoscaler level). The multi-host DATA path — RingFront routing to
+    # HostClient handles — lives in tools/serve_chaos_soak.py and the
+    # serve_multihost bench; this CLI renders locally either way, which is
+    # what keeps ring-off bitwise-identical to the single-process fleet.
+    ring = None
+    scaler = None
+    if serve_cfg.ring_enabled:
+        from mine_tpu.serve import (Autoscaler, HostClient, HostRing,
+                                    pressure_score)
+        ring = HostRing()
+        ring.join("self", aot_loads=engine.bucket_loads,
+                  aot_compiles=engine.bucket_compiles)
+        for addr in filter(None, (a.strip()
+                                  for a in serve_cfg.ring_hosts.split(","))):
+            ring.join(addr)
+            try:
+                HostClient(addr, timeout_s=2.0).healthz()
+            except Exception:  # noqa: BLE001 - unreachable peer = dead slot
+                ring.mark_dead(addr)
+        if serve_cfg.autoscale_enabled:
+            # pressure here is the SLO error-budget burn (the only load
+            # signal the synchronous render path produces); no actuator is
+            # wired — the serve.autoscale trail records what an operator
+            # (or the soak's spawn/drain actuators) should do
+            burn_max = serve_cfg.admission_burn_max or 1.0
+            scaler = Autoscaler(
+                min_hosts=serve_cfg.autoscale_min_hosts,
+                max_hosts=serve_cfg.autoscale_max_hosts,
+                evals=serve_cfg.autoscale_evals,
+                hysteresis=serve_cfg.autoscale_hysteresis,
+                cooldown_s=serve_cfg.autoscale_cooldown_s,
+                score_fn=lambda: pressure_score(burn=slo.burn,
+                                                burn_max=burn_max),
+                hosts_fn=lambda: len(ring.alive()))
+        rs = ring.stats()
+        logger.info("host ring: hosts=%d alive=%d coverage=%.2f "
+                    "autoscale=%s", rs["hosts"], len(rs["alive"]),
+                    rs["coverage"], "on" if scaler is not None else "off")
+        if ops is not None:
+            base_health = ops.health
+            ops.health = lambda: dict(
+                (base_health() if base_health is not None
+                 else {"status": "ok"}),
+                ring=ring.stats(),
+                **({"autoscale": scaler.stats()}
+                   if scaler is not None else {}))
+
     paths = _image_paths(args.data_path)
     if not paths:
         raise FileNotFoundError(f"no images under {args.data_path}")
@@ -240,6 +291,10 @@ def main():
                 logger.info("wrote %s", w)
         slo.record((time.perf_counter() - t_img) * 1e3,
                    bucket=serve_cfg.max_bucket)
+        if scaler is not None:
+            # one control tick per image: the hysteretic streaks make the
+            # serve.autoscale trail meaningful even on short runs
+            scaler.evaluate()
         telemetry.tracing.finish(trace)
         views += sum(t.shape[0] for t in generate_trajectories(
             config.get("data.name", "_default"))[0])
@@ -261,6 +316,15 @@ def main():
                 aot_store.hits if aot_store is not None else 0,
                 aot_store.misses if aot_store is not None else 0,
                 aot_store.saves if aot_store is not None else 0)
+    if ring is not None:
+        rs = ring.stats()
+        logger.info("ring stats: hosts=%d alive=%d draining=%d dead=%d "
+                    "coverage=%.2f rebalances=%d autoscale_level=%s "
+                    "autoscale_decisions=%s",
+                    rs["hosts"], len(rs["alive"]), len(rs["draining"]),
+                    len(rs["dead"]), rs["coverage"], rs["rebalances"],
+                    scaler.level if scaler is not None else "-",
+                    scaler.decisions if scaler is not None else "-")
     if fleet is not None:
         fs = fleet.stats()
         logger.info("fleet stats: mesh=%s shards=%d slo_breaches=%d "

@@ -74,6 +74,7 @@ QUICK = {
     "test_serve_aot.py::test_key_digest_canonical_and_sensitive",
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
+    "test_serve_ring.py::test_ring_covering_through_drains_and_deaths",
     "test_stream_session.py::test_keyframe_ids_share_prefix_and_owner_shard",
     "test_train.py::test_multistep_lr_schedule",
     "test_train_pipeline.py::test_planner_cuts_under_budget",
@@ -127,6 +128,10 @@ MEDIUM_FILES = {
     # deadlines, shard failover — all chaos-driven) plus its default-off
     # bitwise parity bar: same reviewer concern as the two above
     "test_serve_resilience.py",
+    # the multi-host ring over all of it (covering/contiguity, ring-wise
+    # failover routing, autoscaler hysteresis, ring-off bitwise pin,
+    # packed-store safety): ~2 s, same reviewer concern
+    "test_serve_ring.py",
     # the render megakernel's parity/dequant/guard contracts (~2 min of
     # the tier's budget): what a reviewer most wants re-run after touching
     # the kernels, the serve engine, or the cache quant modes

@@ -436,6 +436,91 @@ def test_make_encode_fn_modes():
     del jnp
 
 
+# ---------------- cross-process packed artifact ----------------
+
+def _run_host(args, **kw):
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "mine_tpu.serve.hostnet"] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, **kw)
+
+
+def _kv(line):
+    return dict(tok.split("=", 1) for tok in line.split() if "=" in tok)
+
+
+@pytest.mark.slow
+def test_packed_artifact_boots_subprocess_host_zero_compile(tmp_path):
+    """The multi-host deploy unit end to end, across REAL process
+    boundaries: a builder subprocess compiles through the exact fleet
+    code path hosts boot with and packs ONE artifact; a fresh host
+    subprocess unpacks it and joins with zero live compiles (the
+    ready-line evidence); a HostClient render over the HTTP/JSON hop is
+    bitwise-equal to an identically-configured local fleet; drain exits
+    the host cleanly."""
+    from mine_tpu.serve import HostClient, ServeFleet
+    from mine_tpu.serve.hostnet import SYN_HW, synthetic_encode_fn
+
+    art = str(tmp_path / "store.tar")
+    shape = ["--cache-shards", "1", "--max-bucket", "2",
+             "--max-requests", "2", "--warm-key", "00000001warm",
+             "--warm-seed", "7"]
+    builder = _run_host(["--host-id", "b", "--build-artifact", art]
+                        + shape)
+    out, _ = builder.communicate(timeout=300)
+    built = _kv([ln for ln in out.splitlines() if "built=1" in ln][0])
+    assert builder.returncode == 0
+    assert int(built["compiles"]) > 0 and int(built["loads"]) == 0
+    assert int(built["packed"]) == int(built["compiles"])
+
+    host = _run_host(["--host-id", "x", "--port", "0",
+                      "--aot-artifact", art, "--drain-timeout-s", "5"]
+                     + shape)
+    try:
+        ready = {}
+        for line in host.stdout:
+            if "ready=1" in line:
+                ready = _kv(line)
+                break
+        assert ready, "host never printed its ready line"
+        # the zero-compile join: every program registered from the
+        # packed artifact, none were compiled live
+        assert int(ready["aot_loads"]) > 0
+        assert int(ready["aot_compiles"]) == 0
+
+        local = ServeFleet(cache_shards=1, max_requests=2,
+                           max_wait_ms=2.0, max_bucket=2,
+                           encode_fn=synthetic_encode_fn,
+                           encode_retries=3, encode_backoff_ms=5.0)
+        try:
+            img = np.full((SYN_HW, SYN_HW, 3), 7.0, np.float32)
+            local.engine.put("00000001warm", *synthetic_encode_fn(img))
+            pose = POSE.copy()
+            pose[0, 3] = 0.02
+            client = HostClient("127.0.0.1:%s" % ready["port"],
+                                timeout_s=60.0)
+            assert client.healthz()["state"] == "alive"
+            got_rgb, got_depth = client.render("00000001warm", pose)
+            ref = local.submit("00000001warm", pose).result(timeout=60)
+            # base64 float32 framing is bitwise — the HTTP hop adds
+            # nothing numeric
+            np.testing.assert_array_equal(got_rgb, np.asarray(ref[0]))
+            np.testing.assert_array_equal(got_depth, np.asarray(ref[1]))
+        finally:
+            local.close()
+        client.drain()
+        assert host.wait(timeout=60) == 0
+        assert any("drained=1" in ln for ln in host.stdout)
+    finally:
+        if host.poll() is None:
+            host.terminate()
+            host.wait(timeout=30)
+
+
 # ---------------- tools/aot_warmstore.py ----------------
 
 @pytest.mark.slow

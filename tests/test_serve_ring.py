@@ -1,0 +1,435 @@
+"""Multi-host elastic serving ring (mine_tpu/serve/ring.py, PR 18).
+
+The load-bearing contracts, each asserted here:
+  * COVERING + CONTIGUITY: ownership is a pure function of
+    (image_id, member list, state map) — every key has exactly one alive
+    owner, slot ranges are the contiguous `shard_for_key` cuts, a
+    drained/dead slot's keys resolve ring-wise to the NEXT alive member
+    while every other key stays put, and the last slot wraps to the
+    first;
+  * the membership edges emit the pinned `serve.host_join` /
+    `serve.host_drain` / `serve.ring_rebalance` events and the stream
+    stays strict-schema-clean;
+  * `RingFront` routes to the alive owner, fails over ring-wise when a
+    handle raises `HostUnavailable` (draining) or a connection error
+    (dead), counts owner-hits vs remote-routes per host, and raises only
+    when no member is left;
+  * the `Autoscaler` is hysteretic: `evals` CONSECUTIVE high readings
+    grow, `evals` consecutive low readings shrink, the deadband resets
+    both streaks, cooldown holds after every action, min/max bound the
+    level — so an oscillating score sequence never produces an action
+    trail (the non-flapping pin);
+  * `pressure_score` is the max over normalized signals and a
+    threshold <= 0 disables its signal;
+  * every `serve.ring.*` / `serve.ring.autoscale.*` config key defaults
+    OFF and bad values are rejected at config time;
+  * ring-off is a pure subset: a RingFront over one LocalHost serves
+    BITWISE-identically to calling an identical ServeFleet directly;
+  * `pack_store`/`unpack_store` round-trip a store byte-for-byte,
+    identical stores pack byte-identically, and hostile archive members
+    (path-escaping or foreign-extension) are rejected hard.
+"""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from mine_tpu.config import serve_config_from_dict
+from mine_tpu.serve import (Autoscaler, HostRing, HostUnavailable,
+                            LocalHost, RingFront, ServeFleet,
+                            pressure_score)
+from mine_tpu.serve.aot import PACK_MANIFEST, pack_store, unpack_store
+from mine_tpu.telemetry import events as tevents
+
+HOSTS = ("h0", "h1", "h2", "h3")
+
+
+def _ids(n=256):
+    """Keys spread over the 32-bit ring by a Weyl-ish multiplier."""
+    return ["%08x" % ((i * 2654435761) % (1 << 32)) for i in range(n)]
+
+
+def _ring(hosts=HOSTS):
+    ring = HostRing()
+    for h in hosts:
+        ring.join(h)
+    return ring
+
+
+@pytest.fixture
+def event_stream(tmp_path, monkeypatch):
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    yield path
+    tevents.reset()
+
+
+# ---------------- covering + contiguity ----------------
+
+def test_ring_slot_ranges_are_contiguous():
+    """Slot s of N owns exactly [s*2^32/N, (s+1)*2^32/N) — the
+    shard_for_key discipline one level up."""
+    ring = _ring()
+    for s in range(4):
+        lo = "%08x" % ((s * (1 << 32)) // 4)
+        hi = "%08x" % (((s + 1) * (1 << 32)) // 4 - 1)
+        assert ring.slot_owner(lo) == HOSTS[s]
+        assert ring.slot_owner(hi) == HOSTS[s]
+        assert ring.owner(lo) == HOSTS[s]  # all alive: owner == slot owner
+
+
+def test_ring_covering_through_drains_and_deaths():
+    """Every key has exactly one alive owner at every membership state;
+    a non-alive slot's keys move to the NEXT alive member ring-wise and
+    every other key stays put."""
+    ring = _ring()
+    ids = _ids()
+    owners = {i: ring.owner(i) for i in ids}
+    assert set(owners.values()) == set(HOSTS)  # every slot reachable
+    assert {i: ring.owner(i) for i in ids} == owners  # deterministic
+
+    ring.drain("h1", emit=False)
+    for i in ids:
+        want = "h2" if owners[i] == "h1" else owners[i]
+        assert ring.owner(i) == want
+    ring.mark_dead("h2")
+    for i in ids:
+        want = "h3" if owners[i] in ("h1", "h2") else owners[i]
+        assert ring.owner(i) == want
+    assert {ring.owner(i) for i in ids} == {"h0", "h3"}
+    assert ring.coverage() == 0.5
+    assert ring.stats()["draining"] == ["h1"]
+    assert ring.stats()["dead"] == ["h2"]
+
+
+def test_ring_wraps_and_exhausts():
+    ring = _ring(("a", "b"))
+    ring.drain("b", emit=False)
+    # b owned the top half; its keys wrap past the end to slot 0
+    assert ring.owner("ffffffff" + "img") == "a"
+    ring.drain("a", emit=False)
+    with pytest.raises(HostUnavailable, match="no alive"):
+        ring.owner("00000000")
+    with pytest.raises(HostUnavailable, match="no members"):
+        HostRing().owner("00000000")
+    with pytest.raises(ValueError, match="non-empty"):
+        ring.join("")
+
+
+def test_ring_rejoin_is_idempotent_and_remove_recuts(event_stream):
+    ring = _ring(("a", "b"))
+    joins_before = ring.rebalances
+    ring.join("a")  # alive re-join: nothing changed, no events
+    assert ring.rebalances == joins_before
+    ring.drain("b", emit=False, inflight=0)
+    ring.join("b")  # revival re-cuts ownership
+    assert ring.state("b") == "alive"
+    ring.mark_dead("b")
+    ring.remove("b")
+    assert ring.members() == [("a", "alive")]
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    kinds = [json.loads(line)["kind"] for line in open(event_stream)]
+    assert kinds.count("serve.host_join") == 3
+    assert kinds.count("serve.host_drain") == 0  # emit=False observed it
+    assert "serve.ring_rebalance" in kinds
+
+
+# ---------------- RingFront routing + failover ----------------
+
+class _StubHost:
+    """Handle that renders by echoing (host, image_id); scriptable to
+    refuse (draining) or die (connection reset) on its next call."""
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = []
+        self.fail_with = None
+
+    def render(self, image_id, pose, tier=None, deadline_ms=None,
+               image=None):
+        self.calls.append(image_id)
+        if self.fail_with is not None:
+            raise self.fail_with
+        return (self.name, image_id)
+
+
+def test_front_routes_to_owner_and_counts():
+    ring = _ring(("a", "b"))
+    handles = {"a": _StubHost("a"), "b": _StubHost("b")}
+    front = RingFront(ring, handles, workers=2)
+    lo, hi = "00000000x", "ffffffffx"
+    assert front.render(lo, None) == ("a", lo)
+    assert front.submit(hi, None).result(timeout=10) == ("b", hi)
+    assert front.owner_routes == 2 and front.remote_routes == 0
+    assert front.route_split() == {"a": [1, 0], "b": [1, 0]}
+    assert front.remote_route_fraction() == 0.0
+    assert front.health()["status"] == "ok"
+    front._pool.shutdown(wait=True)
+
+
+def test_front_fails_over_ringwise_and_marks_members():
+    ring = _ring(("a", "b", "c"))
+    handles = {h: _StubHost(h) for h in ("a", "b", "c")}
+    front = RingFront(ring, handles, workers=2)
+    key = "00000000x"  # slot owner: a
+    handles["a"].fail_with = HostUnavailable("draining")
+    handles["b"].fail_with = ConnectionResetError("gone")
+    got = front.render(key, None)
+    assert got == ("c", key)
+    assert ring.state("a") == "draining" and ring.state("b") == "dead"
+    assert front.reroutes == 2 and front.remote_routes == 1
+    assert front.route_split()["c"] == [0, 1]
+    assert front.remote_route_fraction() == 1.0
+    # subsequent requests route straight past the marked members
+    handles["c"].calls.clear()
+    assert front.render(key, None) == ("c", key)
+    assert handles["a"].calls == [key] and handles["b"].calls == [key]
+    # last member refusing exhausts the ring: the error surfaces once
+    # per member, never cycles
+    handles["c"].fail_with = HostUnavailable("draining")
+    with pytest.raises(HostUnavailable):
+        front.render(key, None)
+    assert front.failures == 1
+    assert front.health()["status"] == "down"
+    front._pool.shutdown(wait=True)
+
+
+# ---------------- autoscaler hysteresis ----------------
+
+def _scaler(clock, hosts, trail, **kw):
+    score = [0.0]
+    args = dict(min_hosts=1, max_hosts=3, evals=2, hysteresis=0.5,
+                cooldown_s=10.0, score_fn=lambda: score[0],
+                hosts_fn=lambda: hosts[0],
+                grow_fn=lambda n: (hosts.__setitem__(0, n),
+                                   trail.append("grow")),
+                shrink_fn=lambda n: (hosts.__setitem__(0, n),
+                                     trail.append("shrink")),
+                now_fn=lambda: clock[0])
+    args.update(kw)
+    return Autoscaler(**args), score
+
+
+def test_autoscaler_grow_shrink_with_cooldown_and_bounds():
+    clock, hosts, trail = [0.0], [2], []
+    scaler, score = _scaler(clock, hosts, trail)
+    score[0] = 1.5
+    assert scaler.evaluate() is None        # streak 1 of 2
+    assert scaler.evaluate() == "grow"
+    assert hosts[0] == 3
+    # cooldown: sustained pressure cannot act again yet
+    assert scaler.evaluate() is None
+    clock[0] = 11.0
+    # past cooldown but AT max_hosts: the streak is high, no grow fires
+    assert scaler.evaluate() is None and hosts[0] == 3
+    score[0] = 0.2
+    assert scaler.evaluate() is None        # low streak 1 of 2
+    assert scaler.evaluate() == "shrink" and hosts[0] == 2
+    clock[0] = 22.0
+    assert scaler.evaluate() is None
+    assert scaler.evaluate() == "shrink" and hosts[0] == 1
+    clock[0] = 33.0
+    # AT min_hosts: sustained low pressure never shrinks below
+    assert scaler.evaluate() is None and scaler.evaluate() is None
+    assert hosts[0] == 1
+    assert trail == ["grow", "shrink", "shrink"]
+    s = scaler.stats()
+    assert s["level"] == 1 and s["decisions"] == 3 and not s["cooling"]
+
+
+def test_autoscaler_deadband_resets_streaks():
+    clock, hosts, trail = [0.0], [2], []
+    scaler, score = _scaler(clock, hosts, trail)
+    for reading in (1.2, 0.7, 1.2, 0.7, 1.2):  # deadband breaks streaks
+        score[0] = reading
+        assert scaler.evaluate() is None
+    assert trail == [] and hosts[0] == 2
+
+
+def test_autoscaler_oscillating_score_never_flaps():
+    """The non-flapping pin: a score alternating across both thresholds
+    every tick can never build an `evals` streak, so the action trail
+    stays EMPTY no matter how long it runs."""
+    clock, hosts, trail = [0.0], [2], []
+    scaler, score = _scaler(clock, hosts, trail)
+    for i in range(40):
+        clock[0] = float(i)
+        score[0] = 1.4 if i % 2 == 0 else 0.2
+        assert scaler.evaluate() is None
+    assert trail == []
+
+
+def test_autoscaler_ctor_validation():
+    kw = dict(score_fn=lambda: 0.0, hosts_fn=lambda: 1)
+    with pytest.raises(ValueError, match="min_hosts"):
+        Autoscaler(min_hosts=0, **kw)
+    with pytest.raises(ValueError, match="max_hosts"):
+        Autoscaler(min_hosts=3, max_hosts=2, **kw)
+    with pytest.raises(ValueError, match="evals"):
+        Autoscaler(evals=0, **kw)
+    for h in (0.0, 1.0, 1.5):
+        with pytest.raises(ValueError, match="hysteresis"):
+            Autoscaler(hysteresis=h, **kw)
+
+
+def test_autoscale_events_pinned(event_stream):
+    clock, hosts, trail = [0.0], [1], []
+    scaler, score = _scaler(clock, hosts, trail, evals=1, max_hosts=2)
+    score[0] = 2.0
+    assert scaler.evaluate() == "grow"
+    tevents.reset()
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    ev = [json.loads(line) for line in open(event_stream)
+          if json.loads(line)["kind"] == "serve.autoscale"]
+    assert len(ev) == 1
+    assert ev[0]["action"] == "grow"
+    assert ev[0]["from_hosts"] == 1 and ev[0]["to_hosts"] == 2
+    assert ev[0]["score"] == 2.0
+
+
+def test_pressure_score_max_of_normalized_signals():
+    assert pressure_score() == 0.0
+    assert pressure_score(admission=0.8) == 0.8
+    assert pressure_score(burn=0.5, burn_max=0.25) == 2.0
+    assert pressure_score(remote_frac=0.3, remote_high=0.5) == \
+        pytest.approx(0.6)
+    assert pressure_score(admission=0.9, burn=0.1, burn_max=1.0,
+                          remote_frac=0.1, remote_high=0.5) == 0.9
+    # a threshold <= 0 disables its signal entirely
+    assert pressure_score(burn=9.0, burn_max=0.0) == 0.0
+    assert pressure_score(remote_frac=9.0, remote_high=0.0) == 0.0
+
+
+# ---------------- config knobs ----------------
+
+def test_ring_config_defaults_off_and_validation():
+    cfg = serve_config_from_dict({})
+    assert cfg.ring_enabled is False
+    assert cfg.ring_hosts == ""
+    assert cfg.autoscale_enabled is False
+    on = serve_config_from_dict({
+        "serve.ring.enabled": True,
+        "serve.ring.hosts": "10.0.0.1:8470,10.0.0.2:8470",
+        "serve.ring.autoscale.enabled": True,
+        "serve.ring.autoscale.max_hosts": 8})
+    assert on.ring_enabled and on.autoscale_max_hosts == 8
+    assert len(on.ring_hosts.split(",")) == 2
+    with pytest.raises(ValueError, match="host:port"):
+        serve_config_from_dict({"serve.ring.hosts": "nocolonhere"})
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        serve_config_from_dict({"serve.ring.drain_timeout_s": -1})
+    with pytest.raises(ValueError, match="min_hosts"):
+        serve_config_from_dict({"serve.ring.autoscale.min_hosts": 0})
+    with pytest.raises(ValueError, match="max_hosts"):
+        serve_config_from_dict({"serve.ring.autoscale.min_hosts": 3,
+                                "serve.ring.autoscale.max_hosts": 2})
+    with pytest.raises(ValueError, match="evals"):
+        serve_config_from_dict({"serve.ring.autoscale.evals": 0})
+    with pytest.raises(ValueError, match="hysteresis"):
+        serve_config_from_dict({"serve.ring.autoscale.hysteresis": 1.5})
+
+
+# ---------------- ring-off bitwise pin ----------------
+
+def _tiny_fleet():
+    fleet = ServeFleet(cache_shards=1, max_requests=2, max_wait_ms=1.0,
+                       max_bucket=2)
+    rng = np.random.RandomState(7)
+    p = rng.uniform(-1, 1, (4, 4, 8, 8)).astype(np.float32)
+    fleet.engine.put("img", p[:, 0:3], p[:, 3:4],
+                     np.linspace(1.0, 0.2, 4, dtype=np.float32),
+                     np.eye(3, dtype=np.float32))
+    return fleet
+
+
+def test_one_localhost_ring_is_bitwise_identical_to_direct_fleet():
+    """Ring-off is a pure subset: the front over a single LocalHost adds
+    routing bookkeeping and NOTHING numeric — outputs are bitwise equal
+    to an identical fleet called directly."""
+    ringed, direct = _tiny_fleet(), _tiny_fleet()
+    front = RingFront(_ring(("self",)), {"self": LocalHost(ringed)},
+                      workers=2)
+    try:
+        pose = np.eye(4, dtype=np.float32)
+        pose[0, 3] = 0.02
+        got = front.submit("img", pose).result(timeout=60)
+        ref = direct.submit("img", pose).result(timeout=60)
+        np.testing.assert_array_equal(np.asarray(got[0]),
+                                      np.asarray(ref[0]))
+        np.testing.assert_array_equal(np.asarray(got[1]),
+                                      np.asarray(ref[1]))
+        assert front.owner_routes == 1 and front.remote_routes == 0
+        # a draining LocalHost refuses — the one-host ring exhausts
+        front.handles["self"].draining = True
+        with pytest.raises(HostUnavailable):
+            front.render("img", pose)
+    finally:
+        front.close()  # closes `ringed` through the handle
+        direct.close()
+
+
+# ---------------- packed-store safety ----------------
+
+def _seed_store(root):
+    os.makedirs(root, exist_ok=True)
+    digest = "ab" * 32
+    with open(os.path.join(root, digest + ".aotx"), "wb") as f:
+        f.write(b"executable bytes")
+    with open(os.path.join(root, digest + ".json"), "w") as f:
+        json.dump({"key": {"program": "serve_render"}, "nbytes": 16}, f)
+    return digest
+
+
+def test_pack_unpack_round_trip_byte_identical(tmp_path):
+    src = str(tmp_path / "src")
+    digest = _seed_store(src)
+    art = str(tmp_path / "store.tar")
+    manifest = pack_store(src, art)
+    assert manifest["artifacts"] == 1
+    assert manifest["members"] == [digest + ".aotx", digest + ".json"]
+    with open(art, "rb") as f:
+        first = f.read()
+    pack_store(src, art)  # identical store -> byte-identical pack
+    with open(art, "rb") as f:
+        assert f.read() == first
+
+    dst = str(tmp_path / "dst")
+    got = unpack_store(art, dst)
+    assert got["members"] == manifest["members"]
+    for name in manifest["members"]:
+        with open(os.path.join(src, name), "rb") as a, \
+                open(os.path.join(dst, name), "rb") as b:
+            assert a.read() == b.read()
+    assert not any(n.endswith(".tmp") for n in os.listdir(dst))
+
+
+def _hostile_tar(path, member_name, payload=b"evil"):
+    with tarfile.open(path, "w") as tf:
+        info = tarfile.TarInfo(member_name)
+        info.size = len(payload)
+        tf.addfile(info, io.BytesIO(payload))
+
+
+def test_unpack_rejects_hostile_members(tmp_path):
+    dst = str(tmp_path / "dst")
+    for bad, msg in ((os.path.join("..", "escape.aotx"), "flat file"),
+                     (".hidden.aotx", "flat file"),
+                     ("nested/inner.json", "flat file"),
+                     ("script.sh", "foreign extension")):
+        art = str(tmp_path / "bad.tar")
+        _hostile_tar(art, bad)
+        with pytest.raises(ValueError, match=msg):
+            unpack_store(art, dst)
+    # nothing hostile ever landed in the store dir
+    assert [n for n in os.listdir(dst)
+            if not n.endswith(".tmp")] == []
+    # the manifest itself is the one flat non-store member allowed
+    art = str(tmp_path / "manifest_only.tar")
+    _hostile_tar(art, PACK_MANIFEST, json.dumps({"members": []}).encode())
+    assert unpack_store(art, dst) == {"members": []}

@@ -17,12 +17,21 @@ cold replica boots from (README "Zero-warmup boot"):
                    and a pre-ship hook can gate on it
   --gc             remove stale/corrupt artifacts (--dry_run to preview)
   --list           print the store inventory
+  --pack PATH      pack the store into ONE deployable tar artifact (flat
+                   members + MANIFEST.json with the builder fingerprint;
+                   serve/aot.pack_store) — the unit a ring host ships
+                   with and boots from with zero live compiles
+                   (mine_tpu/serve/hostnet.py --aot-artifact)
+  --unpack PATH    unpack a packed artifact into --store (validated flat
+                   members only; serve/aot.unpack_store)
 
 Usage:
 
   JAX_PLATFORMS=cpu python tools/aot_warmstore.py --store /srv/aot \
       --extra_config '{"serve.max_bucket": 8, "serve.cache_quant": "int8"}'
   python tools/aot_warmstore.py --store /srv/aot --check
+  python tools/aot_warmstore.py --store /srv/aot --pack /srv/aot.pack.tar
+  python tools/aot_warmstore.py --store /on/new/host --unpack aot.pack.tar
 
 Every output line is "key=value"-parseable; the build is idempotent
 (present keys are skipped) and safe to re-run after a jax upgrade — old
@@ -129,6 +138,10 @@ def main(argv=None) -> int:
                     help="print the store inventory")
     ap.add_argument("--dry_run", action="store_true",
                     help="with --gc: report, do not delete")
+    ap.add_argument("--pack", type=str, default="",
+                    help="pack the store into this tar artifact and exit")
+    ap.add_argument("--unpack", type=str, default="",
+                    help="unpack this tar artifact into --store and exit")
     args = ap.parse_args(argv)
 
     from mine_tpu.config import (CONFIG_DIR, load_config,
@@ -148,6 +161,28 @@ def main(argv=None) -> int:
     fp = env_fingerprint()
     print(f"store={root} jax={fp['jax']} backend={fp['backend']} "
           f"devices={fp['devices']}")
+
+    if args.pack and args.unpack:
+        print("error=--pack and --unpack are mutually exclusive")
+        return 2
+
+    if args.pack:
+        from mine_tpu.serve.aot import pack_store
+        manifest = pack_store(root, args.pack)
+        print(f"packed={manifest['artifacts']} "
+              f"members={len(manifest['members'])} "
+              f"bytes={os.path.getsize(args.pack)} out={args.pack}")
+        return 0
+
+    if args.unpack:
+        from mine_tpu.serve.aot import unpack_store
+        manifest = unpack_store(args.unpack, root)
+        stale = "?" if not manifest else \
+            (manifest.get("fingerprint") != fp)
+        print(f"unpacked={len(manifest.get('members', []))} "
+              f"artifacts={store.stats()['artifacts']} store={root} "
+              f"fingerprint_stale={stale}")
+        return 0
 
     if args.list:
         for rec in store.entries():

@@ -87,6 +87,13 @@ LEVERS = [
     # 1-stage x 1-microbatch point, so promote/regress reads the staged
     # step's dispatch overhead against the fused flagship directly
     {"name": "train_pipeline", "variant": "pipepass_b4"},
+    # multi-host ring lever: 2 -> 3 -> 4 CPU-process hosts booted
+    # zero-compile from one packed AOT artifact, aggregate views/sec +
+    # remote-route fraction curve on stderr; the keyed ips is the
+    # largest healthy ring's throughput.  bench builds lacking the
+    # variant return the "skipped: unknown variant" string, which the
+    # conductor reads as a neutral verdict
+    {"name": "serve_multihost"},
 ]
 
 PROMOTE_AT = 1.05

@@ -12,7 +12,10 @@ Consumes the two parseable streams the telemetry layer emits:
 and prints: event counts by kind, span wall-clock stats (count/mean/p50/
 p90/p99 per span path), step-time aggregates, serve bucket-compile history,
 serving-fleet cache placements/rebalances (serve.shard.* events), the
-resilience history (serve.admission state transitions, shard death/revive
+multi-host ring timeline (serve.host_join / serve.host_drain /
+serve.autoscale / serve.ring_rebalance — join/drain history, the
+autoscaler's action trail, and the owner-hit vs remote-route split per
+host), the resilience history (serve.admission state transitions, shard death/revive
 from serve.shard_dead / serve.shard_revive, shed/degraded/expired totals
 out of the metrics snapshot), SLO
 breaches (serve.slo_breach), the slowest request traces as per-trace
@@ -194,6 +197,58 @@ def report(events, log_lines):
             out.append("  rebalance: %s -> %s shards, moved %s of %s entries"
                        % (e.get("from_shards"), e.get("to_shards"),
                           e.get("moved"), e.get("entries")))
+
+    joins = [e for e in events if e.get("kind") == "serve.host_join"]
+    drains = [e for e in events if e.get("kind") == "serve.host_drain"]
+    scales = [e for e in events if e.get("kind") == "serve.autoscale"]
+    ring_rb = [e for e in events if e.get("kind") == "serve.ring_rebalance"]
+    if joins or drains or scales or ring_rb:
+        out.append("")
+        out.append("fleet hosts (content-hash host ring, serve/ring.py):")
+        # join/drain timeline in stream order — each line is one membership
+        # transition with the emitter's view of the alive count after it
+        # (0 = a standalone host with no ring view, hostnet.py)
+        for e in sorted(joins + drains, key=lambda e: e.get("ts") or 0):
+            if e.get("kind") == "serve.host_join":
+                out.append("  JOIN  %-12s hosts=%-3s aot_loads=%-3s "
+                           "aot_compiles=%s"
+                           % (e.get("host"), e.get("hosts"),
+                              e.get("aot_loads"), e.get("aot_compiles")))
+            else:
+                line = ("  DRAIN %-12s hosts=%-3s inflight=%s"
+                        % (e.get("host"), e.get("hosts"), e.get("inflight")))
+                if e.get("reason") is not None:
+                    line += " reason=%s" % e.get("reason")
+                out.append(line)
+        for e in scales:
+            out.append("  autoscale %-7s %s -> %s host(s) score=%s"
+                       % (e.get("action"), e.get("from_hosts"),
+                          e.get("to_hosts"), e.get("score")))
+        if ring_rb:
+            out.append("  ring rebalances: %d (last: %s -> %s alive)"
+                       % (len(ring_rb), ring_rb[-1].get("from_hosts"),
+                          ring_rb[-1].get("to_hosts")))
+        # owner-hit vs remote-route split per host: the front's close()
+        # stamps its per-host route split onto the final ring_rebalance;
+        # draining hosts also report their own fleet-level counters
+        routes = {}
+        for e in ring_rb:
+            if isinstance(e.get("routes"), dict):
+                routes = e["routes"]
+        if routes:
+            out.append("  routes per host (owner / remote):")
+            for host in sorted(routes):
+                pair = routes[host] or [0, 0]
+                total = max(int(pair[0]) + int(pair[1]), 1)
+                out.append("    %-12s %7d %7d  (%4.1f%% remote)"
+                           % (host, pair[0], pair[1],
+                              100.0 * int(pair[1]) / total))
+        for e in drains:
+            if e.get("owner_hits") is not None:
+                out.append("    %-12s fleet-side owner_hits=%s "
+                           "remote_routes=%s"
+                           % (e.get("host"), e.get("owner_hits"),
+                              e.get("remote_routes")))
 
     admissions = [e for e in events if e.get("kind") == "serve.admission"]
     deaths = [e for e in events if e.get("kind") == "serve.shard_dead"]
@@ -442,6 +497,26 @@ def report_json(events, log_lines):
                     and name.endswith("]") and isinstance(v, dict)):
                 render_by_backend[name[len("serve.render_call_ms["):-1]] = v
     out["render_ms_by_backend"] = render_by_backend
+
+    # multi-host ring: join/drain timeline, autoscale trail and the final
+    # per-host route split (owner vs remote) the front stamps on its last
+    # ring_rebalance — enough for a dashboard to draw the host timeline
+    out["hosts"] = {
+        "joins": [{k: e.get(k) for k in ("ts", "host", "hosts",
+                                         "aot_loads", "aot_compiles")}
+                  for e in events if e.get("kind") == "serve.host_join"],
+        "drains": [{k: e.get(k) for k in ("ts", "host", "hosts", "inflight",
+                                          "reason", "owner_hits",
+                                          "remote_routes")}
+                   for e in events if e.get("kind") == "serve.host_drain"],
+        "autoscale": [{k: e.get(k) for k in ("ts", "action", "from_hosts",
+                                             "to_hosts", "score")}
+                      for e in events if e.get("kind") == "serve.autoscale"],
+        "rebalances": [{k: e.get(k) for k in ("ts", "from_hosts",
+                                              "to_hosts", "routes")}
+                       for e in events
+                       if e.get("kind") == "serve.ring_rebalance"],
+    }
 
     out["slo_breaches"] = [
         {k: e.get(k) for k in ("ts", "p99_ms", "objective_ms", "window_s",

@@ -23,6 +23,20 @@ in mine_tpu/testing/faults.py — never by monkeypatching serve code:
             re-encode from the pixels riding each interpolated request —
             zero failed frames, and strictly more sync encodes than the
             healthy ceil(frames/K).
+  hosts     the multi-host ring (serve/ring.py + hostnet.py, --hosts N,
+            0 skips): ONE packed AOT artifact is built in a subprocess
+            (hostnet --build-artifact), N hosts boot from it — each must
+            report aot_loads > 0 with aot_compiles == 0 (zero-compile
+            join) — and a RingFront routes floods at them. Synthetic
+            admission pressure drives the hysteretic Autoscaler to spawn
+            host N+1 (the trail must be non-oscillating: no grow/shrink
+            flapping), then the owner host of a hot key takes a REAL
+            SIGTERM mid-flood while critical requests carry their source
+            image: the drain hands the key range back ring-wise, every
+            critical request still renders (failover hosts sync-encode
+            from the riding pixels), the killed host exits 0 leaving an
+            incident bundle, and a replacement joins — again with zero
+            live compiles.
 
 Every line of output is "phase=<name> key=value ..." (parseable); the run
 exits NONZERO if any invariant breaks:
@@ -34,6 +48,10 @@ exits NONZERO if any invariant breaks:
     or any failed request;
   * the session phase drops a frame, fails to re-encode after the owner
     kill, or ends with the session table non-empty;
+  * the hosts phase boots a host with live compiles, lets a critical
+    request fail through the SIGTERM, leaves the killed host's key range
+    uncovered, oscillates the autoscale trail, or loses the incident
+    bundle the drain must dump;
   * the funneled event stream fails mtpu-ev1 strict validation;
   * the flight recorder (armed for the whole soak) captured no incident
     bundle — the admission shed and shard kill are watched trigger kinds,
@@ -96,6 +114,199 @@ def _settle(futs, timeout):
     return out
 
 
+def run_hosts_phase(args, check, events_path):
+    """Multi-host ring phase: subprocess hosts booted from ONE packed AOT
+    artifact, RingFront routing, a pressure-driven scale-up, and a real
+    SIGTERM through the owner host of live critical traffic. Children
+    inherit MINE_TPU_TELEMETRY_EVENTS so their join/drain events funnel
+    into the parent's stream for the strict-validation pass."""
+    import signal
+    import subprocess
+    import time
+
+    from mine_tpu.serve import HostClient, HostRing, RingFront
+    from mine_tpu.serve.admission import TIER_CRITICAL, TIER_STANDARD
+    from mine_tpu.serve.ring import Autoscaler, pressure_score
+
+    workdir = tempfile.mkdtemp(prefix="serve_soak_hosts_")
+    artifact = os.path.join(workdir, "aot.pack.tar")
+    env = dict(os.environ, PYTHONPATH=REPO,
+               MINE_TPU_TELEMETRY_EVENTS=events_path)
+    hostnet = [sys.executable, "-m", "mine_tpu.serve.hostnet"]
+    warm_key, warm_seed = _key(0, 1, "hostwarm"), 7
+
+    # one artifact for every host: built through the SAME fleet code path
+    # hosts boot with, so the program keys are compatible by construction
+    build = subprocess.run(
+        hostnet + ["--host-id", "builder", "--build-artifact", artifact,
+                   "--cache-shards", "1", "--warm-key", warm_key,
+                   "--warm-seed", str(warm_seed)],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=args.timeout_s)
+    check(build.returncode == 0 and os.path.exists(artifact),
+          f"artifact build failed rc={build.returncode}: "
+          f"{build.stderr.strip()[-300:]}")
+    built = [ln for ln in build.stdout.splitlines() if "built=1" in ln]
+    print(f"phase=hosts {built[0] if built else 'built=?'}", flush=True)
+
+    procs, addrs = {}, {}
+    ring = HostRing()
+    front = RingFront(ring, {})
+
+    def _boot(host_id):
+        """Spawn a host from the packed artifact, assert the zero-compile
+        join evidence on its ready line, and wire it into the front."""
+        p = subprocess.Popen(
+            hostnet + ["--host-id", host_id, "--port", "0",
+                       "--aot-artifact", artifact,
+                       "--warm-key", warm_key,
+                       "--warm-seed", str(warm_seed),
+                       "--drain-timeout-s", "10",
+                       "--incidents-dir",
+                       os.path.join(workdir, f"incidents_{host_id}")],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1)
+        procs[host_id] = p
+        info = {}
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if not line:
+                break
+            fields = dict(kv.split("=", 1)
+                          for kv in line.split() if "=" in kv)
+            if fields.get("ready") == "1":
+                info = fields
+                break
+        check(info.get("ready") == "1",
+              f"host {host_id} never reached ready")
+        if info.get("ready") != "1":
+            return info
+        loads = int(info.get("aot_loads", 0))
+        compiles = int(info.get("aot_compiles", -1))
+        check(loads > 0 and compiles == 0,
+              f"host {host_id} joined with aot_loads={loads} "
+              f"aot_compiles={compiles} (expected a zero-compile join "
+              f"from the packed artifact)")
+        addrs[host_id] = f"127.0.0.1:{info['port']}"
+        front.add_host(host_id,
+                       HostClient(addrs[host_id],
+                                  timeout_s=args.timeout_s),
+                       aot_loads=loads, aot_compiles=compiles)
+        return info
+
+    try:
+        for i in range(args.hosts):
+            _boot(f"h{i}")
+        print(f"phase=hosts hosts={len(ring.alive())} "
+              f"coverage={ring.coverage():.2f} artifact_boots={len(procs)}",
+              flush=True)
+
+        # keys spread across the ring; every request carries its source
+        # image so ANY host can sync-encode a key it never owned — the
+        # zero-critical-failure mechanism through the SIGTERM below
+        mh_keys = [_key(i, 8, f"mh{i}") for i in range(8)]
+        mh_imgs = {k: _image(40 + i) for i, k in enumerate(mh_keys)}
+
+        # synthetic admission pressure drives the hysteretic autoscaler:
+        # two consecutive over-threshold evals grow the ring by ONE host
+        # (the actuator is a real subprocess spawn), the relieved score
+        # then sits in the deadband — the trail must show exactly one
+        # grow and no flapping
+        pressure = {"admission": 2.0}
+        grown, trail = [], []
+
+        def _grow(target):
+            hid = f"h{len(procs)}"
+            _boot(hid)
+            grown.append(hid)
+            pressure["admission"] = 0.8  # relieved into the deadband
+
+        scaler = Autoscaler(
+            min_hosts=args.hosts, max_hosts=args.hosts + 1, evals=2,
+            hysteresis=0.5, cooldown_s=5.0,
+            score_fn=lambda: pressure_score(
+                admission=pressure["admission"],
+                remote_frac=front.remote_route_fraction()),
+            hosts_fn=lambda: len(ring.alive()), grow_fn=_grow)
+        for _ in range(5):
+            flood = _settle(
+                [(TIER_STANDARD, front.submit(k, POSE, image=mh_imgs[k]))
+                 for k in mh_keys], args.timeout_s)
+            check(all(v == "ok" for _, v in flood),
+                  f"ring flood failed pre-kill: {flood}")
+            action = scaler.evaluate()
+            if action is not None:
+                trail.append(action)
+        check(grown and len(ring.alive()) == args.hosts + 1,
+              f"autoscaler never grew the ring (trail={trail})")
+        check(trail == ["grow"],
+              f"autoscale trail oscillated or overshot: {trail}")
+
+        # SIGTERM the alive owner of a hot key mid-flood, critical tier:
+        # the drain 503s new arrivals, the front re-resolves ring-wise,
+        # and the riding image lets the failover host serve the key
+        victim = ring.owner(mh_keys[0])
+        vic_proc = procs[victim]
+        futs = []
+        for j in range(args.host_flood):
+            if j == args.host_flood // 3:
+                vic_proc.send_signal(signal.SIGTERM)
+            k = mh_keys[j % len(mh_keys)]
+            futs.append((TIER_CRITICAL, front.submit(
+                k, POSE, tier=TIER_CRITICAL, image=mh_imgs[k])))
+            time.sleep(0.01)
+        outcomes = _settle(futs, args.timeout_s)
+        crit_bad = [v for _, v in outcomes if v != "ok"]
+        check(not crit_bad,
+              f"critical requests failed through the host kill: "
+              f"{crit_bad}")
+        vic_proc.wait(timeout=args.timeout_s)
+        check(vic_proc.returncode == 0,
+              f"killed host {victim} exited {vic_proc.returncode} "
+              f"(drain should exit 0)")
+        vdir = os.path.join(workdir, f"incidents_{victim}")
+        vbundles = os.listdir(vdir) if os.path.isdir(vdir) else []
+        check(bool(vbundles),
+              f"killed host {victim} left no incident bundle in {vdir}")
+        check(ring.state(victim) in ("draining", "dead"),
+              f"ring never observed {victim} leaving: "
+              f"{ring.state(victim)}")
+        # the dead host's key range must be re-covered: every probe key
+        # resolves to exactly one alive owner, none of them the victim
+        probe_owners = {ring.owner(_key(s, 16, "cov")) for s in range(16)}
+        check(victim not in probe_owners,
+              f"{victim} still owns keys after its drain")
+
+        # a replacement joins — zero live compiles again (_boot asserts)
+        _boot("r0")
+        post = _settle(
+            [(TIER_STANDARD, front.submit(k, POSE, image=mh_imgs[k]))
+             for k in mh_keys], args.timeout_s)
+        check(all(v == "ok" for _, v in post),
+              f"post-replacement renders failed: {post}")
+        print(f"phase=hosts victim={victim} "
+              f"critical={len(futs)} served={sum(v == 'ok' for _, v in outcomes)} "
+              f"grown={grown} trail={','.join(trail)} "
+              f"replacement=r0 reroutes={front.reroutes} "
+              f"remote_frac={front.remote_route_fraction():.3f} "
+              f"bundles={len(vbundles)}", flush=True)
+    finally:
+        for hid, p in procs.items():
+            if p.poll() is None:
+                try:
+                    HostClient(addrs[hid], timeout_s=5.0).drain()
+                except Exception:  # noqa: BLE001 - hard-kill fallback
+                    p.terminate()
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        front.close()  # emits the final ring_rebalance with the routes
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="serve-side chaos soak (overload + shard failover)")
@@ -109,6 +320,12 @@ def main():
     ap.add_argument("--deadline-ms", type=float, default=2000.0,
                     help="per-request deadline for the flooded low tiers")
     ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="subprocess hosts for the multi-host ring phase "
+                         "(0 skips the phase)")
+    ap.add_argument("--host-flood", type=int, default=24,
+                    help="requests routed through the ring during the "
+                         "host-kill flood")
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--events", type=str, default=None,
                     help="event-stream path (default: a temp file)")
@@ -289,6 +506,10 @@ def main():
               f"K={kf_every} served={sum(v == 'ok' for v in outcomes)} "
               f"re_encodes={extra} "
               f"keyframes={session.stats()['keyframes']}", flush=True)
+
+        # ---- phase: hosts (multi-host ring: kill + autoscale) ----
+        if args.hosts > 0:
+            run_hosts_phase(args, check, events_path)
     finally:
         faults.set_plan(None)
         fleet.close()
@@ -301,9 +522,13 @@ def main():
     problems = tevents.validate_file(events_path, strict_kinds=True)
     check(not problems, f"event stream failed strict validation: {problems}")
     kinds = {e["kind"] for e in tevents.read_events(events_path)}
-    for want in ("serve.admission", "serve.shard_dead", "serve.shard_revive",
-                 "serve.session_start", "serve.session_keyframe",
-                 "serve.session_frame", "serve.session_end", "obs.incident"):
+    expected = ["serve.admission", "serve.shard_dead", "serve.shard_revive",
+                "serve.session_start", "serve.session_keyframe",
+                "serve.session_frame", "serve.session_end", "obs.incident"]
+    if args.hosts > 0:
+        expected += ["serve.host_join", "serve.host_drain",
+                     "serve.autoscale", "serve.ring_rebalance"]
+    for want in expected:
         check(want in kinds, f"expected a {want} event in the stream")
 
     # the black box must have caught the soak's own chaos (admission shed

@@ -241,6 +241,16 @@ VARIANTS = {
     # variant skip the row through the unknown-variant path, which the
     # bench conductor reads as neutral.
     "serve_multihost": (1, {}),
+    # FLAKY-LINK arm of the multi-host row: the same ring flood through
+    # policy-armed HostClients (serve.net.*: bounded retry, breaker,
+    # keep-alive) with injected per-attempt latency and a deterministic
+    # every-4th mid-request drop from testing/faults.py. The reading is
+    # GOODPUT (ok views/s — failures excluded) plus the retry rate the
+    # hardening paid to hold it; the row quantifies what the wire
+    # hardening buys on a lossy link instead of asserting it. JSON ips =
+    # goodput; checkouts predating serve.net.* skip the row through the
+    # same unknown-variant path the conductor reads as neutral.
+    "serve_multihost_flaky": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -1320,7 +1330,12 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
     After the healthy sweep, one extra reading repeats the largest ring
     with a member drained ring-side, so the remote-route fraction is a
     measured failover number instead of a structural zero. One parseable
-    stderr line; JSON ips = views/s at the largest healthy ring."""
+    stderr line; JSON ips = views/s at the largest healthy ring.
+
+    The serve_multihost_flaky variant reuses the same boot path with a
+    2-host ring and policy-armed clients, floods through injected
+    latency + drops, and reports GOODPUT and retry rate instead of the
+    curve (see VARIANTS)."""
     import subprocess
     import tempfile
 
@@ -1330,6 +1345,8 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
 
     repo = os.path.dirname(os.path.abspath(__file__))
     counts = SERVE_MULTIHOST_COUNTS[:2] if SMOKE else SERVE_MULTIHOST_COUNTS
+    if name.endswith("_flaky"):
+        counts = SERVE_MULTIHOST_COUNTS[:1]  # the LINK is under test
     n_req = 24 if SMOKE else 128
     n_keys = 8
     workdir = tempfile.mkdtemp(prefix="mtpu_multihost_bench_")
@@ -1414,6 +1431,57 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
                 "serve_multihost: %d flood requests failed: %r"
                 % (len(errs), errs[0].exception()))
             return n / dt
+
+        if name.endswith("_flaky"):
+            # flaky-link arm: the same flood through policy-armed clients
+            # while testing/faults.py injects 1 ms per-attempt latency and
+            # a deterministic every-4th mid-request drop. Goodput counts
+            # ONLY ok renders — a failure lowers the number instead of
+            # aborting the row — and the retry counters price the
+            # hardening that held it.
+            import concurrent.futures as cf
+
+            from mine_tpu.serve import NetPolicy
+            from mine_tpu.testing import faults
+            policy = NetPolicy(enabled=True, retries=3, backoff_ms=2.0,
+                               breaker_threshold=1000)
+            net = {hid: HostClient(handles[hid].address, timeout_s=300.0,
+                                   policy=policy, net_src="bench",
+                                   net_name=hid)
+                   for hid in list(handles)[:counts[-1]]}
+            ring = HostRing()
+            front = RingFront(ring, {}, policy=policy)
+            for hid, c in net.items():
+                front.add_host(hid, c)
+            try:
+                flood(front, max(n_req // 4, n_keys))  # clean warm-up
+                faults.set_plan(faults.FaultPlan(net_latency_ms=1,
+                                                 net_drop_every=4))
+                t0 = time.perf_counter()
+                futs = [front.submit(keys[i % n_keys], pose,
+                                     image=imgs[keys[i % n_keys]])
+                        for i in range(n_req)]
+                cf.wait(futs, timeout=600)
+                dt = time.perf_counter() - t0
+            finally:
+                faults.set_plan(None)
+                front.close()
+            ok = sum(f.exception() is None for f in futs)
+            retries = sum(c.retries for c in net.values())
+            reconnects = sum(c.reconnects for c in net.values())
+            goodput = ok / dt
+            print("  serve_multihost_flaky: hosts=%d goodput=%.3f "
+                  "retry_rate=%.3f retries=%d reconnects=%d failed=%d "
+                  "(ok views/s under net_latency_ms=1 net_drop_every=4, "
+                  "%d req)"
+                  % (counts[-1], goodput, retries / n_req, retries,
+                     reconnects, n_req - ok, n_req), file=sys.stderr)
+            from mine_tpu import telemetry
+            telemetry.emit("serve.multihost_point", hosts=counts[-1],
+                           views_per_sec=round(goodput, 3),
+                           remote_frac=round(
+                               front.remote_route_fraction(), 4))
+            return goodput, None, None, 1
 
         def arm(H, drain_one=False):
             ring = HostRing()

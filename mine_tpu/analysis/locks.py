@@ -78,6 +78,14 @@ from typing import Dict, List, Optional
 #                     call (which re-enters batcher.cv/fleet.cache on a
 #                     local host) — so both rank below batcher.cv; the
 #                     membership-change events nest ascending under ring
+#   net.breaker       a HostClient's per-host circuit-breaker state
+#                     (serve/hostnet.py CircuitBreaker): taken on the
+#                     request path AFTER the front/ring locks release
+#                     (handle calls hold neither), and the prober's
+#                     miss bookkeeping may hold ring.front (7) while a
+#                     breaker snapshot reads it — so it ranks above ring
+#                     (8); transitions emit AFTER release, so nothing
+#                     above it is ever taken under it
 LOCK_RANKS: Dict[str, int] = {
     "telemetry.recorder.dump": 2,
     "telemetry.recorder.state": 3,
@@ -86,6 +94,7 @@ LOCK_RANKS: Dict[str, int] = {
     "serve.hostnet.state": 6,
     "serve.ring.front": 7,
     "serve.ring": 8,
+    "serve.net.breaker": 9,
     "serve.batcher.cv": 10,
     "serve.fleet.cache": 15,
     "telemetry.recorder.ring": 18,
@@ -211,10 +220,13 @@ def ordered_condition(name: str,
 # the thread names the serve plane owns and must JOIN on close() — an
 # alive one after teardown is the unjoined-thread regression (PR-8).
 # The flight-recorder dump worker and the resource-gauge sampler joined
-# the list with PR 15: both have explicit close() paths.
+# the list with PR 15: both have explicit close() paths; the ring front's
+# heartbeat prober (serve/ring.py, serve.net.probe_interval_s) joined
+# with PR 19 — RingFront.close() stops and joins it.
 OWNED_THREAD_NAMES = ("mine-tpu-serve-batcher", "mine-tpu-ops-server",
                       "mine-tpu-flight-recorder",
-                      "mine-tpu-resource-sampler")
+                      "mine-tpu-resource-sampler",
+                      "mine-tpu-ring-prober")
 
 
 def leaked_threads(baseline=None):

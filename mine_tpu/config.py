@@ -395,6 +395,24 @@ class ServeConfig:
     autoscale_evals: int = 3
     autoscale_hysteresis: float = 0.5
     autoscale_cooldown_s: float = 30.0
+    # serve.net.*: wire hardening of the ring transport (serve/hostnet.py
+    # NetPolicy) — split connect/read timeouts, bounded jittered retries,
+    # per-host circuit breakers, deadline propagation over the hop, and
+    # the front's heartbeat failure detector (suspect = route around,
+    # front-local; only sustained connection-REFUSED marks dead).
+    # Disabled by default: net-off constructs none of it and the wire
+    # behavior is bitwise-identical to the unhardened transport.
+    net_enabled: bool = False
+    net_connect_timeout_s: float = 5.0
+    net_read_timeout_s: float = 60.0
+    net_retries: int = 2
+    net_backoff_ms: float = 20.0
+    net_breaker_threshold: int = 5
+    net_breaker_reset_s: float = 10.0
+    net_probe_interval_s: float = 0.0
+    net_suspect_misses: int = 3
+    net_dead_misses: int = 10
+    net_revive_probes: int = 2
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -450,6 +468,19 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
             g("serve.ring.autoscale.hysteresis", 0.5)),
         autoscale_cooldown_s=float(
             g("serve.ring.autoscale.cooldown_s", 30.0) or 0.0),
+        net_enabled=bool(g("serve.net.enabled", False)),
+        net_connect_timeout_s=float(
+            g("serve.net.connect_timeout_s", 5.0)),
+        net_read_timeout_s=float(g("serve.net.read_timeout_s", 60.0)),
+        net_retries=int(g("serve.net.retries", 2)),
+        net_backoff_ms=float(g("serve.net.backoff_ms", 20.0)),
+        net_breaker_threshold=int(g("serve.net.breaker_threshold", 5)),
+        net_breaker_reset_s=float(g("serve.net.breaker_reset_s", 10.0)),
+        net_probe_interval_s=float(
+            g("serve.net.probe_interval_s", 0.0) or 0.0),
+        net_suspect_misses=int(g("serve.net.suspect_misses", 3)),
+        net_dead_misses=int(g("serve.net.dead_misses", 10)),
+        net_revive_probes=int(g("serve.net.revive_probes", 2)),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -583,6 +614,44 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.ring.autoscale.cooldown_s must be >= 0, "
             f"got {out.autoscale_cooldown_s}")
+    if out.net_connect_timeout_s <= 0:
+        raise ValueError(
+            f"serve.net.connect_timeout_s must be > 0, "
+            f"got {out.net_connect_timeout_s}")
+    if out.net_read_timeout_s <= 0:
+        raise ValueError(
+            f"serve.net.read_timeout_s must be > 0, "
+            f"got {out.net_read_timeout_s}")
+    if out.net_retries < 0:
+        raise ValueError(
+            f"serve.net.retries must be >= 0, got {out.net_retries}")
+    if out.net_backoff_ms < 0:
+        raise ValueError(
+            f"serve.net.backoff_ms must be >= 0, got {out.net_backoff_ms}")
+    if out.net_breaker_threshold < 1:
+        raise ValueError(
+            f"serve.net.breaker_threshold must be >= 1, "
+            f"got {out.net_breaker_threshold}")
+    if out.net_breaker_reset_s < 0:
+        raise ValueError(
+            f"serve.net.breaker_reset_s must be >= 0, "
+            f"got {out.net_breaker_reset_s}")
+    if out.net_probe_interval_s < 0:
+        raise ValueError(
+            f"serve.net.probe_interval_s must be >= 0, "
+            f"got {out.net_probe_interval_s}")
+    if out.net_suspect_misses < 1:
+        raise ValueError(
+            f"serve.net.suspect_misses must be >= 1, "
+            f"got {out.net_suspect_misses}")
+    if out.net_dead_misses < 1:
+        raise ValueError(
+            f"serve.net.dead_misses must be >= 1, "
+            f"got {out.net_dead_misses}")
+    if out.net_revive_probes < 1:
+        raise ValueError(
+            f"serve.net.revive_probes must be >= 1, "
+            f"got {out.net_revive_probes}")
     return out
 
 

@@ -40,9 +40,11 @@ from mine_tpu.serve.cache import (MPICache, MPIEntry, PyramidCache,
                                   quantize_planes)
 from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.serve.fleet import ServeFleet, ShardedPlaneCache, shard_for_key
-from mine_tpu.serve.hostnet import HostClient, HostServer
-from mine_tpu.serve.ring import (Autoscaler, HostRing, HostUnavailable,
-                                 LocalHost, RingFront, pressure_score)
+from mine_tpu.serve.hostnet import (CircuitBreaker, HostClient, HostServer,
+                                    NetPolicy)
+from mine_tpu.serve.ring import (Autoscaler, BreakerOpen, HostRing,
+                                 HostUnavailable, LocalHost, RingFront,
+                                 pressure_score)
 from mine_tpu.serve.session import (StreamSession, keyframe_id, probe_drift,
                                     relative_pose, session_key_prefix)
 from mine_tpu.serve.stream import SessionManager
@@ -51,9 +53,10 @@ from mine_tpu.serve.shardmap import (SERVE_BATCH_AXIS, SERVE_MODEL_AXIS,
                                      render_shardings)
 
 __all__ = [
-    "AOTStore", "AdmissionController", "Autoscaler", "ContinuousBatcher",
+    "AOTStore", "AdmissionController", "Autoscaler", "BreakerOpen",
+    "CircuitBreaker", "ContinuousBatcher",
     "DeadlineExceeded", "HostClient", "HostRing", "HostServer",
-    "HostUnavailable", "LocalHost", "MPICache", "MPIEntry",
+    "HostUnavailable", "LocalHost", "MPICache", "MPIEntry", "NetPolicy",
     "MeshRenderEngine", "MicroBatcher", "PyramidCache", "RenderEngine",
     "RequestShed", "RingFront", "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS",
     "ServeFleet", "SessionManager", "ShardedPlaneCache", "StreamSession",

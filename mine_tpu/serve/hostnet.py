@@ -28,6 +28,21 @@ dump a flight-recorder incident bundle when a recorder is armed, and close
 the fleet. The key range hands back to the ring the moment any front
 observes the 503 (serve/ring.py re-resolves ring-wise).
 
+The transport is WIRE-HARDENED behind `serve.net.*` (all default off;
+net-off constructs none of the machinery and stays bitwise-identical,
+test-pinned): `NetPolicy` gives the client split connect/read timeouts,
+bounded jittered-exponential-backoff retries (safe: a render is a pure
+function of key+pose, so at-least-once is idempotent), a per-host
+`CircuitBreaker` (closed -> open -> half-open with single-probe
+admission, pinned `serve.breaker` events), and deadline propagation —
+the budget LEFT rides the `X-Mtpu-Deadline-Left-Ms` header so a host
+SWEEPS work the front already expired into the existing DeadlineExceeded
+envelope instead of rendering it. Connections are kept alive per thread
+(HTTP/1.1 + reconnect-on-stale), and every network fault a test needs —
+latency, refusal, mid-response reset, truncation, partition — is
+injected through the testing/faults.py net_* seams, never by
+monkeypatching this module.
+
 `main()` is the deployable unit's entrypoint: boot a host from a PACKED
 AOT artifact (tools/aot_warmstore.py --pack) with zero live compiles and
 serve until drained. Run `python -m mine_tpu.serve.hostnet --help`.
@@ -36,7 +51,10 @@ serve until drained. Run `python -m mine_tpu.serve.hostnet --help`.
 from __future__ import annotations
 
 import base64
+import dataclasses
+import http.client
 import json
+import random
 import threading
 import time
 from typing import Dict, Optional
@@ -44,9 +62,11 @@ from typing import Dict, Optional
 import numpy as np
 
 from mine_tpu import telemetry
-from mine_tpu.analysis.locks import ordered_condition
+from mine_tpu.analysis.locks import ordered_condition, ordered_lock
 from mine_tpu.serve.admission import DeadlineExceeded, RequestShed
-from mine_tpu.serve.ring import HOST_ALIVE, HOST_DRAINING, HostUnavailable
+from mine_tpu.serve.ring import (HOST_ALIVE, HOST_DRAINING, BreakerOpen,
+                                 HostUnavailable)
+from mine_tpu.testing import faults
 
 # synthetic-host geometry (--synthetic): matches tools/serve_chaos_soak.py
 # so the soak's keys/images render identically through subprocess hosts
@@ -87,6 +107,111 @@ _KIND_RAISE = {"RequestShed": RequestShed,
                "DeadlineExceeded": DeadlineExceeded,
                "HostUnavailable": HostUnavailable}
 
+# the front's remaining deadline budget, in milliseconds, as seen at send
+# time — the server sweeps non-positive values into the 504 envelope
+DEADLINE_HEADER = "X-Mtpu-Deadline-Left-Ms"
+
+
+@dataclasses.dataclass(frozen=True)
+class NetPolicy:
+    """The serve.net.* knobs as one immutable value (config.py parses the
+    keys; serve_cli builds this and hands it to every HostClient and the
+    RingFront). `enabled=False` — the default — constructs NONE of the
+    hardening: no breaker, no retries, no deadline header, no prober."""
+
+    enabled: bool = False
+    connect_timeout_s: float = 5.0   # TCP connect budget (fail fast)
+    read_timeout_s: float = 60.0     # response budget (renders are slow)
+    retries: int = 2                 # extra attempts after the first
+    backoff_ms: float = 20.0         # base of the jittered exponential
+    breaker_threshold: int = 5       # consecutive failures -> open
+    breaker_reset_s: float = 10.0    # open -> half-open after this long
+    probe_interval_s: float = 0.0    # front heartbeat period (0 = off)
+    suspect_misses: int = 3          # consecutive probe misses -> suspect
+    dead_misses: int = 10            # consecutive REFUSED -> mark_dead
+    revive_probes: int = 2           # consecutive oks -> clear suspicion
+
+
+class CircuitBreaker:
+    """Per-host client-side circuit: closed -> open after `threshold`
+    consecutive failures, open -> half-open after `reset_s`, half-open
+    admits ONE probe at a time — success closes, failure re-opens. State
+    transitions emit the pinned `serve.breaker` event and bump
+    `serve.net.breaker_<state>`; emits happen AFTER the lock releases
+    (the "serve.net.breaker" rank sits below telemetry, see
+    analysis/locks.py). `now_fn` is injectable so tests drive the reset
+    window with a fake clock."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, host: str, threshold: int, reset_s: float,
+                 now_fn=time.monotonic):
+        self.host = str(host)
+        self.threshold = int(threshold)
+        self.reset_s = float(reset_s)
+        self._now = now_fn
+        self._lock = ordered_lock("serve.net.breaker")
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opens = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        """May a request go to the wire right now?"""
+        transition = None
+        ok = False
+        with self._lock:
+            if self.state == self.CLOSED:
+                ok = True
+            elif self.state == self.OPEN:
+                if self._now() - self._opened_at >= self.reset_s:
+                    self.state = self.HALF_OPEN
+                    self._probing = True
+                    transition = self.HALF_OPEN
+                    ok = True
+            else:  # HALF_OPEN: one probe in flight at a time
+                if not self._probing:
+                    self._probing = True
+                    ok = True
+            failures = self.failures
+        if transition:
+            self._emit(transition, failures)
+        return ok
+
+    def record(self, ok: bool) -> None:
+        """Feed one wire verdict (every attempt, probe or request)."""
+        transition = None
+        with self._lock:
+            self._probing = False
+            if ok:
+                if self.state != self.CLOSED:
+                    transition = self.CLOSED
+                self.state = self.CLOSED
+                self.failures = 0
+            else:
+                self.failures += 1
+                if (self.state == self.HALF_OPEN
+                        or (self.state == self.CLOSED
+                            and self.failures >= self.threshold)):
+                    self.opens += 1
+                    transition = self.OPEN
+                    self.state = self.OPEN
+                    self._opened_at = self._now()
+            failures = self.failures
+        if transition:
+            self._emit(transition, failures)
+
+    def _emit(self, state: str, failures: int) -> None:
+        telemetry.emit("serve.breaker", host=self.host, state=state,
+                       failures=int(failures))
+        telemetry.counter(f"serve.net.breaker_{state}").inc()
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"state": self.state, "failures": self.failures,
+                    "opens": self.opens}
+
 
 class HostServer:
     """One ring host: a ServeFleet behind the stdlib HTTP/JSON transport.
@@ -108,11 +233,17 @@ class HostServer:
         self.draining = False
         self.inflight = 0
         self.requests = 0
+        self.swept = 0  # requests the deadline header expired on arrival
         self.drained = threading.Event()
         self._cv = ordered_condition("serve.hostnet.state")
         srv = self
 
         class _Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 + the always-set Content-Length = keep-alive:
+            # the client's per-thread connection survives across
+            # renders instead of paying TCP setup on every request
+            protocol_version = "HTTP/1.1"
+
             def _send(self, code: int, body: bytes,
                       ctype: str = "application/json") -> None:
                 self.send_response(code)
@@ -147,7 +278,15 @@ class HostServer:
                     n = int(self.headers.get("Content-Length", 0) or 0)
                     body = json.loads(self.rfile.read(n) or b"{}")
                     if path == "/render":
-                        code, obj = srv._handle_render(body)
+                        left = None
+                        raw = self.headers.get(DEADLINE_HEADER)
+                        if raw is not None:
+                            try:
+                                left = float(raw)
+                            except ValueError:
+                                left = None  # malformed = absent
+                        code, obj = srv._handle_render(
+                            body, deadline_left_ms=left)
                         self._send_json(code, obj)
                     elif path == "/drain":
                         # hand back asynchronously: the response must go
@@ -173,13 +312,28 @@ class HostServer:
 
     # -- request path -----------------------------------------------------
 
-    def _handle_render(self, body: Dict):
+    def _handle_render(self, body: Dict, deadline_left_ms=None):
+        if deadline_left_ms is not None and deadline_left_ms <= 0:
+            # the front's budget was spent in flight: sweep instead of
+            # rendering work nobody is waiting on — same verdict (and
+            # client-side exception) as the batcher's own expiry sweep
+            with self._cv:
+                self.swept += 1
+            telemetry.counter("serve.net.deadline_swept").inc()
+            return 504, {"ok": False, "kind": "DeadlineExceeded",
+                         "error": "deadline spent before host dispatch"}
         with self._cv:
             if self.draining:
                 return 503, {"ok": False, "kind": "HostUnavailable",
                              "error": "draining"}
             self.inflight += 1
             self.requests += 1
+        deadline_ms = body.get("deadline_ms")
+        if deadline_left_ms is not None:
+            # the host-local batcher sweeps against whichever budget is
+            # tighter: the request's own or what the front has left
+            deadline_ms = (min(float(deadline_ms), deadline_left_ms)
+                           if deadline_ms else deadline_left_ms)
         try:
             pose = np.asarray(body["pose"],
                               np.float32).reshape(4, 4)
@@ -187,7 +341,7 @@ class HostServer:
             rgb, depth = self.fleet.submit(
                 str(body["image_id"]), pose,
                 tier=body.get("tier"),
-                deadline_ms=body.get("deadline_ms"),
+                deadline_ms=deadline_ms,
                 image=unpack_array(image) if image else None).result()
             return 200, {"ok": True, "rgb": pack_array(rgb),
                          "depth": pack_array(depth)}
@@ -263,6 +417,7 @@ class HostServer:
         with self._cv:
             out.update(host=self.host_id, requests=self.requests,
                        inflight=self.inflight, draining=self.draining,
+                       swept=self.swept,
                        bucket_loads=getattr(engine, "bucket_loads", 0),
                        bucket_compiles=getattr(engine, "bucket_compiles",
                                                0))
@@ -293,34 +448,163 @@ def install_drain_signals(server: HostServer):
     return handler
 
 
+# a kept-alive connection the server closed under us looks like one of
+# these on the NEXT request — reconnect once, transparently (a fresh
+# connection failing the same way is a real failure, not staleness)
+_STALE = (http.client.BadStatusLine, http.client.CannotSendRequest,
+          ConnectionResetError, BrokenPipeError)
+# what a bounded retry may absorb: transport errors, protocol garbage,
+# truncated/mangled JSON — never an application verdict (the error
+# envelope arrives as a 200..5xx with valid JSON and is re-raised typed)
+_RETRYABLE = (OSError, http.client.HTTPException, json.JSONDecodeError)
+
+
 class HostClient:
     """Stdlib HTTP client half of the transport; satisfies the RingFront
-    handle protocol (render/healthz/stats/close). One connection per call
-    — thread-safe without pooling, and the ring's request rate is bounded
-    by render time, not connection setup."""
+    handle protocol (render/healthz/stats/close). Connections are kept
+    alive PER THREAD (`threading.local` — the RingFront pool shares one
+    client across workers, and http.client connections are not
+    thread-safe), with one transparent reconnect when the server closed
+    a kept-alive socket under us.
 
-    def __init__(self, address: str, timeout_s: float = 60.0):
+    With a NetPolicy (serve.net.*) the client is hardened: split
+    connect/read timeouts, `retries` extra attempts with jittered
+    exponential backoff, a per-host CircuitBreaker consulted before and
+    fed after every wire attempt, and the request's remaining deadline
+    budget sent as `X-Mtpu-Deadline-Left-Ms` (expired budget raises
+    DeadlineExceeded CLIENT-side, without a wire attempt). Policy-off
+    keeps the legacy single-attempt, single-timeout behavior.
+
+    `net_src`/`net_name` tag this client's edge in the faults.py
+    partition matrix ("src>dst") so tests sever individual links."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0,
+                 policy: Optional[NetPolicy] = None, net_src: str = "front",
+                 net_name: str = ""):
         host, port = address.rsplit(":", 1)
         self.host = host
         self.port = int(port)
         self.address = address
         self.timeout_s = float(timeout_s)
+        self.policy = policy if (policy is not None
+                                 and policy.enabled) else None
+        self.breaker: Optional[CircuitBreaker] = None
+        if self.policy is not None:
+            self.breaker = CircuitBreaker(address,
+                                          self.policy.breaker_threshold,
+                                          self.policy.breaker_reset_s)
+        self.net_src = str(net_src)
+        self.net_name = str(net_name) or address
+        self._local = threading.local()
+        self.reconnects = 0  # stale keep-alive sockets replaced
+        self.retries = 0     # policy retry attempts actually taken
+
+    # -- connection management (per thread) -------------------------------
+
+    def _conn(self) -> "http.client.HTTPConnection":
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            timeout = (self.policy.connect_timeout_s if self.policy
+                       else self.timeout_s)
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _wire(self, method: str, path: str, payload, headers):
+        """One HTTP round over this thread's kept-alive connection."""
+        conn = self._conn()
+        if conn.sock is None:
+            conn.connect()  # under connect_timeout_s
+            if self.policy is not None:
+                conn.sock.settimeout(self.policy.read_timeout_s)
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        if faults.net_truncate():
+            self._drop_conn()
+            raise http.client.IncompleteRead(data[:len(data) // 2])
+        return resp.status, json.loads(data or b"{}")
+
+    def _attempt(self, method: str, path: str, payload, headers):
+        """One logical attempt: the fault seam, the wire, and at most one
+        transparent reconnect when a REUSED connection turned out stale.
+        A fresh connection's failure always propagates — retrying it is
+        the retry loop's (counted) job, not this layer's."""
+        faults.net_request(self.net_src, self.net_name)
+        conn = getattr(self._local, "conn", None)
+        reused = conn is not None and conn.sock is not None
+        try:
+            return self._wire(method, path, payload, headers)
+        except _STALE:
+            self._drop_conn()
+            if not reused:
+                raise
+            self.reconnects += 1
+            telemetry.counter("serve.net.reconnects").inc()
+            try:
+                return self._wire(method, path, payload, headers)
+            except Exception:
+                self._drop_conn()
+                raise
+        except Exception:
+            self._drop_conn()
+            raise
+
+    # -- request path -----------------------------------------------------
 
     def _request(self, method: str, path: str,
-                 body: Optional[Dict] = None):
-        import http.client
-
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout_s)
-        try:
-            payload = json.dumps(body).encode() if body is not None \
-                else None
-            conn.request(method, path, body=payload,
-                         headers={"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            return resp.status, json.loads(resp.read() or b"{}")
-        finally:
-            conn.close()
+                 body: Optional[Dict] = None,
+                 deadline_ms: Optional[float] = None,
+                 retry: bool = True):
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        pol = self.policy
+        attempts = 1 + (pol.retries if (pol is not None and retry) else 0)
+        t0 = time.monotonic()
+        for attempt in range(attempts):
+            if (pol is not None and deadline_ms is not None
+                    and deadline_ms > 0):
+                left = float(deadline_ms) - (time.monotonic() - t0) * 1e3
+                if left <= 0:
+                    telemetry.counter("serve.net.deadline_expired").inc()
+                    raise DeadlineExceeded(
+                        f"{self.address}: {deadline_ms:.0f}ms budget "
+                        f"spent client-side after {attempt} attempt(s)")
+                headers[DEADLINE_HEADER] = f"{left:.1f}"
+            if self.breaker is not None and not self.breaker.allow():
+                raise BreakerOpen(f"{self.address}: circuit open")
+            try:
+                status, obj = self._attempt(method, path, payload,
+                                            headers)
+            except _RETRYABLE as e:
+                if self.breaker is not None:
+                    self.breaker.record(False)
+                if isinstance(e, TimeoutError):
+                    # socket.timeout IS TimeoutError on py3.10+
+                    telemetry.counter("serve.net.timeouts").inc()
+                elif isinstance(e, ConnectionRefusedError):
+                    telemetry.counter("serve.net.refused").inc()
+                if attempt + 1 >= attempts:
+                    raise
+                self.retries += 1
+                telemetry.counter("serve.net.retries").inc()
+                time.sleep(pol.backoff_ms / 1e3 * (2 ** attempt)
+                           * (0.5 + random.random()))
+                continue
+            if self.breaker is not None:
+                self.breaker.record(True)
+            return status, obj
+        raise RuntimeError("unreachable")  # loop always returns/raises
 
     def render(self, image_id, pose, tier=None, deadline_ms=None,
                image=None):
@@ -329,12 +613,33 @@ class HostClient:
                 "tier": tier, "deadline_ms": deadline_ms,
                 "image": pack_array(np.asarray(image, np.float32))
                 if image is not None else None}
-        status, obj = self._request("POST", "/render", body)
+        status, obj = self._request("POST", "/render", body,
+                                    deadline_ms=deadline_ms)
         if status == 200 and obj.get("ok"):
             return unpack_array(obj["rgb"]), unpack_array(obj["depth"])
         kind = obj.get("kind", "")
         exc = _KIND_RAISE.get(kind, RuntimeError)
         raise exc(f"{self.address}: {obj.get('error', f'HTTP {status}')}")
+
+    def probe(self) -> Dict:
+        """One /healthz round-trip that BYPASSES allow(): the front's
+        heartbeat prober IS the half-open admission — its verdict feeds
+        the breaker either way, so an open circuit heals from probes
+        without spending a caller's request on it."""
+        headers = {"Content-Type": "application/json"}
+        try:
+            _, obj = self._attempt("GET", "/healthz", None, headers)
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record(False)
+            raise
+        if self.breaker is not None:
+            self.breaker.record(True)
+        return obj
+
+    def breaker_snapshot(self) -> Optional[Dict]:
+        return self.breaker.snapshot() if self.breaker is not None \
+            else None
 
     def healthz(self) -> Dict:
         return self._request("GET", "/healthz")[1]
@@ -343,10 +648,12 @@ class HostClient:
         return self._request("GET", "/stats")[1]
 
     def drain(self) -> Dict:
-        return self._request("POST", "/drain", {})[1]
+        return self._request("POST", "/drain", {}, retry=False)[1]
 
     def close(self) -> None:
-        pass  # connections are per-call; nothing is held
+        # drops the CALLING thread's kept-alive socket; other threads'
+        # are closed by GC when the client goes away (daemon pool)
+        self._drop_conn()
 
 
 def _entries_counts(limit: int):

@@ -41,11 +41,14 @@ bitwise-identical to the single-process path (test-pinned).
 from __future__ import annotations
 
 import concurrent.futures
+import socket
+import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 
 from mine_tpu import telemetry
 from mine_tpu.analysis.locks import ordered_lock
+from mine_tpu.serve.admission import DeadlineExceeded
 from mine_tpu.serve.fleet import shard_for_key
 
 _METRIC_PREFIX = "serve.ring"
@@ -61,6 +64,16 @@ class HostUnavailable(RuntimeError):
 
     The front treats this as a routing fact, not a request failure: the
     member is marked and the request re-resolves ring-wise."""
+
+
+class BreakerOpen(RuntimeError):
+    """A host's client-side circuit is open: the hardened HostClient
+    (serve/hostnet.py, serve.net.* keys) refused to even attempt the wire.
+
+    Deliberately NOT a ConnectionError: the front treats an open circuit
+    like front-local suspicion — route around the host for now — never
+    like a confirmed death, because the breaker's evidence is "this
+    client keeps failing", not "nothing is listening"."""
 
 
 class HostRing:
@@ -156,12 +169,21 @@ class HostRing:
 
     # -- ownership --------------------------------------------------------
 
-    def owner(self, image_id: str) -> str:
+    def owner(self, image_id: str, avoid=()) -> str:
         """The unique alive owner of `image_id`: its slot owner, or —
         when that member is draining/dead — the next alive member
-        ring-wise. Deterministic in (id, member list, state map)."""
+        ring-wise. Deterministic in (id, member list, state map).
+
+        `avoid` is a front-LOCAL preference set (suspect / breaker-open
+        hosts): alive members in it are skipped when any other alive
+        member can take the key, but an avoided host is still better
+        than no host — when every alive member is avoided, the plain
+        ring-wise owner is returned. Avoidance never touches membership
+        state, which is what keeps suspicion partition-safe (no
+        split-brain: two fronts with different suspicions still agree on
+        the membership map)."""
         with self._lock:
-            return self._owner_locked(image_id)
+            return self._owner_locked(image_id, avoid)
 
     def slot_owner(self, image_id: str) -> str:
         """The member whose RANGE contains the key, alive or not (what
@@ -172,15 +194,23 @@ class HostRing:
             return self._members[shard_for_key(image_id,
                                                len(self._members))]
 
-    def _owner_locked(self, image_id: str) -> str:
+    def _owner_locked(self, image_id: str, avoid=()) -> str:
         n = len(self._members)
         if n == 0:
             raise HostUnavailable("ring has no members")
         o = shard_for_key(image_id, n)
+        fallback: Optional[str] = None
         for step in range(n):
             cand = self._members[(o + step) % n]
-            if self._state[cand] == HOST_ALIVE:
-                return cand
+            if self._state[cand] != HOST_ALIVE:
+                continue
+            if cand in avoid:
+                if fallback is None:
+                    fallback = cand  # ring-wise first avoided-alive member
+                continue
+            return cand
+        if fallback is not None:
+            return fallback  # every alive member is suspect: best effort
         raise HostUnavailable("ring has no alive hosts")
 
     # -- introspection ----------------------------------------------------
@@ -290,46 +320,103 @@ class RingFront:
     carry the source image so a failover host can sync-encode a key it
     never owned; that is what keeps critical traffic at zero failures
     through a host SIGTERM (tools/serve_chaos_soak.py host-kill phase).
+
+    With a NetPolicy (serve.net.* keys) the front also runs the failure
+    detector: a heartbeat prober thread pings every alive member's
+    /healthz each `probe_interval_s`; `suspect_misses` consecutive misses
+    make the host SUSPECT — new keys route around it (ring.owner avoid=)
+    but membership is untouched — and `revive_probes` consecutive
+    successes clear the suspicion (the Autoscaler's hysteresis shape, so
+    a flapping link never flaps ownership). Only `dead_misses`
+    consecutive CONNECTION-REFUSED probes — nothing is listening, not
+    just slow — take the authoritative `mark_dead` edge. Suspicion being
+    front-local and membership single-writer is the no-split-brain
+    property the partition tests pin. Request-path failures feed the same
+    state machine: a timeout or open breaker suspects, a refused/reset
+    connection marks dead.
     """
 
     def __init__(self, ring: HostRing, handles: Dict[str, object],
-                 workers: int = 8) -> None:
+                 workers: int = 8, policy=None) -> None:
         self.ring = ring
         self.handles = dict(handles)
         self.owner_routes = 0
         self.remote_routes = 0
         self.reroutes = 0
         self.failures = 0
+        self.front_expired = 0   # requests expired before leaving the front
         self._per_host: Dict[str, List[int]] = {}  # host -> [owner, remote]
         self._lock = ordered_lock("serve.ring.front")
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="ring-front")
+        # --- failure detector (serve.net.*; None/off = legacy behavior) --
+        self.policy = policy if (policy is not None
+                                 and getattr(policy, "enabled", False)) \
+            else None
+        self._suspects: set = set()
+        self._probe_miss: Dict[str, int] = {}    # consecutive probe misses
+        self._refused_miss: Dict[str, int] = {}  # consecutive REFUSED misses
+        self._ok_streak: Dict[str, int] = {}     # consecutive ok probes
+        self.probe_misses = 0
+        self._now = time.monotonic  # injectable for deadline tests
+        self._probe_stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        # without a prober there is no revive path, so request successes
+        # must clear suspicion; with one, only probes revive (hysteresis)
+        self._request_revives = (self.policy is None
+                                 or self.policy.probe_interval_s <= 0)
+        if self.policy is not None and self.policy.probe_interval_s > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="mine-tpu-ring-prober",
+                daemon=True)
+            self._prober.start()
 
     def add_host(self, host: str, handle, aot_loads: int = 0,
                  aot_compiles: int = 0) -> None:
         with self._lock:
             self.handles[host] = handle
+            self._probe_miss.pop(host, None)
+            self._refused_miss.pop(host, None)
+            self._suspects.discard(host)
         self.ring.join(host, aot_loads=aot_loads,
                        aot_compiles=aot_compiles)
 
     def submit(self, image_id: str, pose, tier=None, deadline_ms=None,
                image=None) -> "concurrent.futures.Future":
+        t0 = self._now()  # deadline budget starts at ENQUEUE, not dispatch
         return self._pool.submit(self._route_one, image_id, pose, tier,
-                                 deadline_ms, image)
+                                 deadline_ms, image, t0)
 
     def render(self, image_id: str, pose, tier=None, deadline_ms=None,
                image=None):
-        return self._route_one(image_id, pose, tier, deadline_ms, image)
+        return self._route_one(image_id, pose, tier, deadline_ms, image,
+                               self._now())
 
-    def _route_one(self, image_id, pose, tier, deadline_ms, image):
+    def _route_one(self, image_id, pose, tier, deadline_ms, image,
+                   t0=None):
         slot_owner = self.ring.slot_owner(image_id)
         last_err: Optional[Exception] = None
         tried: set = set()
         # at most one attempt per member: each failure marks the member,
         # so the next resolve walks past it — bounded, never cycles
         for _ in range(len(self.ring.members())):
+            send_deadline = deadline_ms
+            if (self.policy is not None and deadline_ms is not None
+                    and deadline_ms > 0 and t0 is not None):
+                left = float(deadline_ms) - (self._now() - t0) * 1e3
+                if left <= 0:
+                    # expired before ever reaching a host (pool queueing,
+                    # failover walking): don't waste a wire attempt
+                    with self._lock:
+                        self.front_expired += 1
+                    telemetry.counter("serve.net.front_expired").inc()
+                    raise DeadlineExceeded(
+                        f"deadline {deadline_ms}ms spent before dispatch")
+                send_deadline = left
             try:
-                host = self.ring.owner(image_id)
+                with self._lock:
+                    avoid: FrozenSet[str] = frozenset(self._suspects)
+                host = self.ring.owner(image_id, avoid=avoid)
             except HostUnavailable as e:
                 last_err = e
                 break
@@ -343,10 +430,25 @@ class RingFront:
                 continue
             try:
                 out = handle.render(image_id, pose, tier=tier,
-                                    deadline_ms=deadline_ms, image=image)
+                                    deadline_ms=send_deadline, image=image)
             except HostUnavailable as e:
                 last_err = e
                 self.ring.drain(host, emit=False)
+                self._count_reroute()
+                continue
+            except DeadlineExceeded:
+                raise  # the request's fault, not the host's: no marking
+            except BreakerOpen as e:
+                last_err = e
+                self._suspect_host(host)
+                self._count_reroute()
+                continue
+            except (TimeoutError, socket.timeout) as e:
+                # order matters: socket.timeout IS TimeoutError on 3.10+
+                # and both subclass OSError — a slow host is SUSPECT
+                # (front-local), never dead (membership edge)
+                last_err = e
+                self._suspect_host(host)
                 self._count_reroute()
                 continue
             except (ConnectionError, OSError) as e:
@@ -362,6 +464,123 @@ class RingFront:
         raise last_err if last_err is not None else HostUnavailable(
             "no host served %r" % image_id)
 
+    # -- failure detector -------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        interval = float(self.policy.probe_interval_s)
+        while not self._probe_stop.wait(interval):
+            try:
+                self.probe_once()
+            except Exception:
+                pass  # the detector must never kill its own thread
+
+    def probe_once(self) -> None:
+        """One heartbeat round over the alive members. Split out from the
+        thread loop so tests (and the partition property checks) can
+        drive the detector deterministically."""
+        for host, state in self.ring.members():
+            if state != HOST_ALIVE:
+                continue
+            with self._lock:
+                handle = self.handles.get(host)
+            if handle is None:
+                continue
+            probe = getattr(handle, "probe", None) or handle.healthz
+            try:
+                probe()
+            except ConnectionRefusedError:
+                self._probe_miss_host(host, refused=True)
+            except Exception:
+                self._probe_miss_host(host, refused=False)
+            else:
+                self._probe_ok_host(host)
+
+    def _probe_ok_host(self, host: str) -> None:
+        clear = False
+        with self._lock:
+            self._probe_miss[host] = 0
+            self._refused_miss[host] = 0
+            if host in self._suspects:
+                streak = self._ok_streak.get(host, 0) + 1
+                self._ok_streak[host] = streak
+                if streak >= self.policy.revive_probes:
+                    self._suspects.discard(host)
+                    self._ok_streak[host] = 0
+                    clear = True
+        if clear:
+            telemetry.emit("serve.host_suspect", host=host, state="alive",
+                           misses=0)
+            telemetry.counter("serve.net.revives").inc()
+
+    def _probe_miss_host(self, host: str, refused: bool) -> None:
+        suspect = dead = False
+        misses = 0
+        with self._lock:
+            self.probe_misses += 1
+            self._ok_streak[host] = 0
+            misses = self._probe_miss.get(host, 0) + 1
+            self._probe_miss[host] = misses
+            if refused:
+                self._refused_miss[host] = \
+                    self._refused_miss.get(host, 0) + 1
+            else:
+                self._refused_miss[host] = 0
+            if (misses >= self.policy.suspect_misses
+                    and host not in self._suspects):
+                self._suspects.add(host)
+                suspect = True
+            # only sustained REFUSAL is evidence nothing is listening;
+            # sustained timeouts could be a slow link (stay suspect)
+            if self._refused_miss[host] >= self.policy.dead_misses:
+                self._suspects.discard(host)
+                dead = True
+        telemetry.counter("serve.net.probe_misses").inc()
+        if suspect:
+            telemetry.emit("serve.host_suspect", host=host,
+                           state="suspect", misses=misses)
+            telemetry.counter("serve.net.suspects").inc()
+        if dead:
+            telemetry.emit("serve.host_suspect", host=host, state="dead",
+                           misses=misses)
+            self.ring.mark_dead(host)
+
+    def _suspect_host(self, host: str) -> None:
+        """Request-path suspicion (timeout / breaker-open): same state as
+        a probe-driven suspicion, so the prober's revive path clears it."""
+        with self._lock:
+            if host in self._suspects:
+                return
+            self._suspects.add(host)
+            misses = self._probe_miss.get(host, 0)
+        telemetry.emit("serve.host_suspect", host=host, state="suspect",
+                       misses=misses)
+        telemetry.counter("serve.net.suspects").inc()
+
+    def suspects(self) -> List[str]:
+        with self._lock:
+            return sorted(self._suspects)
+
+    def net_stats(self) -> Dict:
+        """The failure detector + per-host breaker view (stats()/health()
+        "net" section; the soak's flaky-link phase asserts over it)."""
+        with self._lock:
+            out = {
+                "suspects": sorted(self._suspects),
+                "probe_misses": self.probe_misses,
+                "front_expired": self.front_expired,
+            }
+            handles = dict(self.handles)
+        breakers = {}
+        for host, handle in handles.items():
+            snap = getattr(handle, "breaker_snapshot", None)
+            val = snap() if snap is not None else None
+            if val is not None:
+                breakers[host] = val
+        out["breakers"] = breakers
+        return out
+
+    # -- tallies ----------------------------------------------------------
+
     def _count_route(self, host: str, is_owner: bool) -> None:
         with self._lock:
             tally = self._per_host.setdefault(host, [0, 0])
@@ -371,8 +590,15 @@ class RingFront:
             else:
                 self.remote_routes += 1
                 tally[1] += 1
+            revive = (self._request_revives and host in self._suspects)
+            if revive:
+                self._suspects.discard(host)
+                self._probe_miss[host] = 0
         name = "owner_route" if is_owner else "remote_route"
         telemetry.counter(f"{_METRIC_PREFIX}.{name}").inc()
+        if revive:
+            telemetry.emit("serve.host_suspect", host=host, state="alive",
+                           misses=0)
 
     def _count_reroute(self) -> None:
         with self._lock:
@@ -400,16 +626,25 @@ class RingFront:
                 "per_host": {h: list(v) for h, v in self._per_host.items()},
             }
         out["ring"] = self.ring.stats()
+        if self.policy is not None:
+            out["net"] = self.net_stats()
         return out
 
     def health(self) -> Dict:
         ring = self.ring.stats()
-        return {
+        out = {
             "status": "ok" if ring["alive"] else "down",
             "ring": ring,
         }
+        if self.policy is not None:
+            out["net"] = self.net_stats()
+        return out
 
     def close(self) -> None:
+        if self._prober is not None:
+            self._probe_stop.set()
+            self._prober.join(timeout=10.0)
+            self._prober = None
         # the front's final route ledger, attached to one last rebalance
         # record so postmortems see the split without scraping counters
         alive = len(self.ring.alive())

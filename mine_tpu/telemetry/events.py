@@ -296,6 +296,17 @@ KIND_FIELDS: Dict[str, tuple] = {
     # one point per serve_multihost bench arm (bench.py): ring size vs
     # aggregate throughput and the front's remote-route fraction
     "serve.multihost_point": ("hosts", "views_per_sec", "remote_frac"),
+    # wire hardening (serve.net.*, PR 19). serve.breaker: one event per
+    # circuit-breaker TRANSITION (state = open|half_open|closed; failures
+    # = the consecutive-failure count at the edge) — edge-triggered like
+    # serve.admission, and "open" is a flight-recorder trigger kind.
+    # serve.host_suspect: the heartbeat detector's front-local verdict
+    # trail (state = suspect|alive|dead; misses = consecutive probe
+    # misses at the edge) — suspect routes around the host WITHOUT a
+    # membership write, alive is the post-heal re-convergence edge, dead
+    # accompanies the mark_dead membership edge on confirmed refusal.
+    "serve.breaker": ("host", "state", "failures"),
+    "serve.host_suspect": ("host", "state", "misses"),
 }
 
 

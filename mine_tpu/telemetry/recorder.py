@@ -84,6 +84,11 @@ TRIGGER_KINDS: Dict[str, Optional[Callable[[Dict], bool]]] = {
     # worthy: the bundle captures the drain, the re-covered key range and
     # whatever pressure preceded it
     "serve.host_drain": None,
+    # a circuit OPENING means a host ate breaker_threshold consecutive
+    # transport failures — a breaker-open storm (several hosts at once)
+    # is the fleet-wide network incident; debounce coalesces the storm
+    # into one bundle instead of one per edge
+    "serve.breaker": lambda f: f.get("state") == "open",
 }
 
 

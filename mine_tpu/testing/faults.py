@@ -38,6 +38,27 @@ drive the PR-11 self-protecting-serving layer through them):
   * ``queue_flood`` — a burst size the soak/test harness reads via
     ``queue_flood_n`` and submits as one instantaneous tier-0 flood.
 
+Transport seams (the wire-hardening layer: serve/hostnet.py HostClient
+calls ``net_request``/``net_truncate`` on every wire attempt, so network
+chaos never monkeypatches hostnet):
+
+  * ``net_latency_ms`` — client-side sleep before every wire attempt
+    (a slow link; builds toward the split read timeout).
+  * ``net_refuse_times`` — the first k wire attempts raise
+    ConnectionRefusedError (a vanished host: nothing is listening).
+  * ``net_drop_every`` — every Nth wire attempt (global counter) raises
+    ConnectionResetError mid-request: the flaky link the client's
+    bounded retry must absorb. Deterministic — two consecutive attempts
+    of one request can never both land on the modulus.
+  * ``net_truncate_times`` — the first k responses are truncated
+    mid-body (the client raises IncompleteRead; a retry re-reads).
+  * ``net_partition`` — an asymmetric partition matrix as a
+    comma-separated list of directed ``src>dst`` links to sever
+    (``"h1>n2,h2>n1"``: the fronts named h1/h2 cannot reach the hosts
+    named n2/n1, while every unlisted pair — e.g. an external front —
+    connects normally). Matching is by the client's (net_src, net_name)
+    identity pair; severed links raise ConnectionRefusedError.
+
 The plan comes from ``set_plan`` (tests), the MINE_TPU_FAULTS env var
 (subprocess legs of the chaos soak), or a config's ``testing.fault_plan``
 JSON (train_cli). With no plan active every hook is a cheap no-op, so the
@@ -89,10 +110,18 @@ class FaultPlan:
     shard_kill_heal_after: int = -1  # injected failures before it heals
     slow_render_ms: int = -1       # host sleep before each render dispatch
     queue_flood: int = -1          # burst size the soak reads (queue_flood_n)
+    net_latency_ms: int = -1       # client sleep before each wire attempt
+    net_refuse_times: int = -1     # first k wire attempts refused
+    net_drop_every: int = -1       # every Nth wire attempt resets mid-request
+    net_truncate_times: int = -1   # first k responses truncated mid-body
+    net_partition: str = ""        # severed "src>dst" links, comma-separated
 
     @property
     def active(self) -> bool:
-        return any(v != -1 for v in dataclasses.asdict(self).values())
+        # int faults disable at -1, string faults at "" — any other value
+        # anywhere arms the plan
+        return any(v not in (-1, "")
+                   for v in dataclasses.asdict(self).values())
 
 
 _lock = threading.Lock()
@@ -126,12 +155,16 @@ def plan_from_spec(spec) -> Optional[FaultPlan]:
         return None
     if isinstance(spec, str):
         spec = json.loads(spec)
-    known = {f.name for f in dataclasses.fields(FaultPlan)}
-    unknown = set(spec) - known
+    fields = {f.name: f for f in dataclasses.fields(FaultPlan)}
+    unknown = set(spec) - set(fields)
     if unknown:
         raise KeyError(f"unknown fault plan keys: {sorted(unknown)} "
-                       f"(known: {sorted(known)})")
-    return FaultPlan(**{k: int(v) for k, v in spec.items()})
+                       f"(known: {sorted(fields)})")
+    # coerce by declared field type: int faults take counts/steps, string
+    # faults (the partition matrix) pass through verbatim
+    return FaultPlan(**{k: (str(v) if fields[k].type in ("str", str)
+                            else int(v))
+                        for k, v in spec.items()})
 
 
 def activate(config=None):
@@ -235,6 +268,55 @@ def on_render():
     if plan is None or plan.slow_render_ms < 0:
         return
     time.sleep(plan.slow_render_ms / 1e3)
+
+
+def net_request(src: str, dst: str):
+    """Called by HostClient at the top of EVERY wire attempt with the
+    client's identity pair (net_src, net_name). Raises the planned
+    transport failure — partition first (a severed link refuses before
+    anything else can happen), then bounded refusals, then latency, then
+    the deterministic every-Nth drop — so one seam drives every network
+    failure mode the hardened client must absorb."""
+    plan = _plan
+    if plan is None:
+        return
+    if plan.net_partition:
+        links = {tuple(p.split(">", 1))
+                 for p in plan.net_partition.split(",") if ">" in p}
+        if (src, dst) in links:
+            raise ConnectionRefusedError(
+                f"injected partition: link {src}>{dst} severed")
+    if plan.net_refuse_times >= 0:
+        with _lock:
+            n = _counts.get("net_refused", 0)
+            if n < plan.net_refuse_times:
+                _counts["net_refused"] = n + 1
+                raise ConnectionRefusedError(
+                    f"injected connection refusal #{n + 1} ({src}->{dst})")
+    if plan.net_latency_ms > 0:
+        time.sleep(plan.net_latency_ms / 1e3)
+    if plan.net_drop_every > 0:
+        with _lock:
+            call = _counts.get("net_calls", 0) + 1
+            _counts["net_calls"] = call
+        if call % plan.net_drop_every == 0:
+            raise ConnectionResetError(
+                f"injected mid-request drop (wire attempt #{call})")
+
+
+def net_truncate() -> bool:
+    """Called by HostClient after reading a response body; True means this
+    response must be treated as truncated mid-body (the first
+    `net_truncate_times` responses only — a retry then reads it whole)."""
+    plan = _plan
+    if plan is None or plan.net_truncate_times < 0:
+        return False
+    with _lock:
+        n = _counts.get("net_truncated", 0)
+        if n >= plan.net_truncate_times:
+            return False
+        _counts["net_truncated"] = n + 1
+    return True
 
 
 def queue_flood_n() -> int:

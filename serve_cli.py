@@ -215,17 +215,45 @@ def main():
     # what keeps ring-off bitwise-identical to the single-process fleet.
     ring = None
     scaler = None
+    peer_clients = {}
     if serve_cfg.ring_enabled:
         from mine_tpu.serve import (Autoscaler, HostClient, HostRing,
-                                    pressure_score)
+                                    NetPolicy, pressure_score)
+        # wire hardening (serve.net.*, default off): peer probes get the
+        # split timeouts/retries/breakers, and /healthz surfaces every
+        # peer's breaker state next to the ring view
+        net_policy = None
+        if serve_cfg.net_enabled:
+            net_policy = NetPolicy(
+                enabled=True,
+                connect_timeout_s=serve_cfg.net_connect_timeout_s,
+                read_timeout_s=serve_cfg.net_read_timeout_s,
+                retries=serve_cfg.net_retries,
+                backoff_ms=serve_cfg.net_backoff_ms,
+                breaker_threshold=serve_cfg.net_breaker_threshold,
+                breaker_reset_s=serve_cfg.net_breaker_reset_s,
+                probe_interval_s=serve_cfg.net_probe_interval_s,
+                suspect_misses=serve_cfg.net_suspect_misses,
+                dead_misses=serve_cfg.net_dead_misses,
+                revive_probes=serve_cfg.net_revive_probes)
+            logger.info("net hardening: connect=%.1fs read=%.1fs "
+                        "retries=%d breaker_threshold=%d probe=%.1fs",
+                        net_policy.connect_timeout_s,
+                        net_policy.read_timeout_s, net_policy.retries,
+                        net_policy.breaker_threshold,
+                        net_policy.probe_interval_s)
         ring = HostRing()
         ring.join("self", aot_loads=engine.bucket_loads,
                   aot_compiles=engine.bucket_compiles)
         for addr in filter(None, (a.strip()
                                   for a in serve_cfg.ring_hosts.split(","))):
             ring.join(addr)
+            client = HostClient(addr, timeout_s=2.0, policy=net_policy,
+                                net_src="self", net_name=addr)
+            if net_policy is not None:
+                peer_clients[addr] = client  # kept for breaker snapshots
             try:
-                HostClient(addr, timeout_s=2.0).healthz()
+                client.healthz()
             except Exception:  # noqa: BLE001 - unreachable peer = dead slot
                 ring.mark_dead(addr)
         if serve_cfg.autoscale_enabled:
@@ -254,7 +282,11 @@ def main():
                  else {"status": "ok"}),
                 ring=ring.stats(),
                 **({"autoscale": scaler.stats()}
-                   if scaler is not None else {}))
+                   if scaler is not None else {}),
+                **({"net": {"breakers": {
+                    a: c.breaker_snapshot()
+                    for a, c in peer_clients.items()}}}
+                   if peer_clients else {}))
 
     paths = _image_paths(args.data_path)
     if not paths:

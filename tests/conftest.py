@@ -74,6 +74,7 @@ QUICK = {
     "test_serve_aot.py::test_key_digest_canonical_and_sensitive",
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
+    "test_serve_net.py::test_breaker_state_machine_with_events",
     "test_serve_ring.py::test_ring_covering_through_drains_and_deaths",
     "test_stream_session.py::test_keyframe_ids_share_prefix_and_owner_shard",
     "test_train.py::test_multistep_lr_schedule",
@@ -132,6 +133,10 @@ MEDIUM_FILES = {
     # failover routing, autoscaler hysteresis, ring-off bitwise pin,
     # packed-store safety): ~2 s, same reviewer concern
     "test_serve_ring.py",
+    # the wire-hardening layer under the ring (retry/breaker/keep-alive,
+    # deadline propagation, failure detector, the partition no-split-brain
+    # property pair tier-1 gates explicitly): ~5 s, same reviewer concern
+    "test_serve_net.py",
     # the render megakernel's parity/dequant/guard contracts (~2 min of
     # the tier's budget): what a reviewer most wants re-run after touching
     # the kernels, the serve engine, or the cache quant modes

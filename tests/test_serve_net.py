@@ -1,0 +1,644 @@
+"""Wire hardening of the multi-host ring (serve.net.*, PR 19).
+
+The load-bearing contracts, each asserted here:
+  * the `CircuitBreaker` state machine: closed -> open after `threshold`
+    consecutive failures, open -> half-open after `reset_s` (one probe
+    admitted at a time), success closes, failure re-opens — with the
+    pinned `serve.breaker` event trail;
+  * the hardened `HostClient` absorbs transient refusals, mid-request
+    resets and truncated responses with its bounded jittered retry —
+    every failure injected through the testing/faults.py net_* seams,
+    never by monkeypatching hostnet;
+  * keep-alive reuse: one kept-alive connection per thread, and a server
+    restart under the client is healed by ONE transparent reconnect
+    (counted, unconditional — policy-off clients reconnect too);
+  * deadline propagation: a request whose budget is spent never reaches
+    a host (front-side), and a host sweeps an expired
+    `X-Mtpu-Deadline-Left-Ms` header into the 504 DeadlineExceeded
+    envelope BEFORE touching its batcher (server-side);
+  * the heartbeat failure detector: consecutive probe misses SUSPECT a
+    host (routed around for new keys, membership untouched), consecutive
+    successes revive it (hysteresis), and only sustained
+    connection-REFUSED probes take the authoritative mark_dead edge;
+  * PARTITION SAFETY (the pair tools/verify_tier1.sh gates explicitly):
+    under an asymmetric partition every front still sees one alive owner
+    per key, no front writes membership state (no split-brain), an
+    unpartitioned front serves through both hosts — and healing the
+    partition re-converges every front's owner map;
+  * `serve.breaker` / `serve.host_suspect` are pinned kinds, breaker
+    `state=open` arms the flight recorder, and every serve.net.* config
+    key defaults OFF with bad values rejected at config time;
+  * net-off constructs NONE of the machinery: no policy, no breaker, no
+    prober thread, no deadline header, no "net" stats section.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mine_tpu.config import serve_config_from_dict
+from mine_tpu.serve import (BreakerOpen, CircuitBreaker, HostClient,
+                            HostRing, HostServer, HostUnavailable,
+                            NetPolicy, RingFront)
+from mine_tpu.serve.admission import DeadlineExceeded
+from mine_tpu.serve.hostnet import DEADLINE_HEADER
+from mine_tpu.telemetry import events as tevents
+from mine_tpu.telemetry.events import KIND_FIELDS
+from mine_tpu.telemetry.recorder import TRIGGER_KINDS
+from mine_tpu.testing import faults
+
+
+@pytest.fixture
+def event_stream(tmp_path, monkeypatch):
+    monkeypatch.delenv(tevents.ENV_VAR, raising=False)
+    tevents.reset()
+    path = str(tmp_path / "ev.jsonl")
+    tevents.configure(path)
+    yield path
+    tevents.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+def _events(path, kind=None):
+    out = [json.loads(line) for line in open(path)]
+    return [e for e in out if kind is None or e["kind"] == kind]
+
+
+# ---------------- a JAX-free fleet stub behind a REAL HostServer -------
+
+class _Future:
+    def __init__(self, value):
+        self._v = value
+
+    def result(self, timeout=None):
+        if isinstance(self._v, Exception):
+            raise self._v
+        return self._v
+
+
+class _StubFleet:
+    """Just enough fleet for HostServer: submit().result() echoes fixed
+    arrays, so the wire/deadline machinery is tested without JAX."""
+
+    def __init__(self):
+        self.submits = 0
+        self.deadlines = []
+
+    def submit(self, image_id, pose, tier=None, deadline_ms=None,
+               image=None):
+        self.submits += 1
+        self.deadlines.append(deadline_ms)
+        return _Future((np.full((2, 2, 3), 1.0, np.float32),
+                        np.full((2, 2), 2.0, np.float32)))
+
+    def health(self):
+        return {"status": "ok"}
+
+    def stats(self):
+        return {}
+
+    def close(self):
+        pass
+
+
+def _server(host_id="n0", port=0):
+    fleet = _StubFleet()
+    srv = HostServer(fleet, host_id, port=port).start()
+    return srv, fleet
+
+
+POSE = np.eye(4, dtype=np.float32)
+
+
+# ---------------- circuit breaker ----------------
+
+def test_breaker_state_machine_with_events(event_stream):
+    clock = [0.0]
+    b = CircuitBreaker("h:1", threshold=2, reset_s=5.0,
+                       now_fn=lambda: clock[0])
+    assert b.allow() and b.snapshot()["state"] == "closed"
+    b.record(False)
+    assert b.allow()  # one failure below threshold: still closed
+    b.record(False)   # threshold -> OPEN
+    assert b.snapshot() == {"state": "open", "failures": 2, "opens": 1}
+    assert not b.allow()
+    clock[0] = 5.0    # reset window elapsed -> HALF-OPEN, one probe
+    assert b.allow()
+    assert not b.allow()  # second caller: the probe is in flight
+    b.record(False)   # probe failed -> straight back to OPEN
+    assert b.snapshot()["state"] == "open" and b.snapshot()["opens"] == 2
+    clock[0] = 10.0
+    assert b.allow()
+    b.record(True)    # probe succeeded -> CLOSED, failures reset
+    assert b.snapshot() == {"state": "closed", "failures": 0, "opens": 2}
+    assert b.allow()
+    tevents.reset()
+    assert tevents.validate_file(event_stream, strict_kinds=True) == []
+    trail = [(e["state"], e["failures"])
+             for e in _events(event_stream, "serve.breaker")]
+    assert trail == [("open", 2), ("half_open", 2), ("open", 3),
+                     ("half_open", 3), ("closed", 0)]
+
+
+def test_breaker_event_kind_pinned_and_triggers_recorder():
+    assert KIND_FIELDS["serve.breaker"] == ("host", "state", "failures")
+    assert KIND_FIELDS["serve.host_suspect"] == ("host", "state", "misses")
+    trig = TRIGGER_KINDS["serve.breaker"]
+    assert trig({"state": "open"}) and not trig({"state": "closed"})
+
+
+# ---------------- hardened client: retries over injected faults -------
+
+def test_client_retry_absorbs_refusals_and_truncation():
+    srv, fleet = _server()
+    policy = NetPolicy(enabled=True, retries=3, backoff_ms=1.0)
+    client = HostClient(f"127.0.0.1:{srv.port}", policy=policy,
+                        net_src="t", net_name="n0")
+    try:
+        faults.set_plan(faults.FaultPlan(net_refuse_times=2))
+        rgb, depth = client.render("img", POSE)
+        assert rgb.shape == (2, 2, 3) and client.retries == 2
+        faults.set_plan(faults.FaultPlan(net_truncate_times=1))
+        before = client.retries
+        client.render("img", POSE)
+        assert client.retries == before + 1
+        # refused attempts never reached the fleet; the truncated one
+        # did (truncation is client-side, post-read) and so did its retry
+        assert fleet.submits == 3
+    finally:
+        client.close()
+        srv.drain(reason="test")
+
+
+def test_client_retries_exhaust_to_the_typed_error():
+    policy = NetPolicy(enabled=True, retries=1, backoff_ms=1.0,
+                       breaker_threshold=100)
+    client = HostClient("127.0.0.1:1", policy=policy, net_src="t",
+                        net_name="x")  # port 1: nothing listens
+    faults.set_plan(faults.FaultPlan(net_refuse_times=99))
+    with pytest.raises(ConnectionRefusedError):
+        client.healthz()
+    assert client.retries == 1  # 1 + retries attempts, then it surfaces
+    assert client.breaker_snapshot()["failures"] == 2
+
+
+def test_breaker_opens_and_probe_is_the_admission():
+    policy = NetPolicy(enabled=True, retries=0, backoff_ms=1.0,
+                       breaker_threshold=2, breaker_reset_s=1e9)
+    client = HostClient("127.0.0.1:1", policy=policy, net_src="t",
+                        net_name="x")
+    faults.set_plan(faults.FaultPlan(net_refuse_times=99))
+    for _ in range(2):
+        with pytest.raises(ConnectionRefusedError):
+            client.healthz()
+    assert client.breaker_snapshot()["state"] == "open"
+    with pytest.raises(BreakerOpen):  # no wire attempt is even made
+        client.healthz()
+    # probe() bypasses allow() — it IS the half-open admission — and its
+    # verdict feeds the breaker either way
+    faults.set_plan(None)
+    srv, _ = _server()
+    healed = HostClient(f"127.0.0.1:{srv.port}", policy=policy,
+                        net_src="t", net_name="n0")
+    try:
+        faults.set_plan(faults.FaultPlan(net_refuse_times=2))
+        for _ in range(2):
+            with pytest.raises(ConnectionRefusedError):
+                healed.render("img", POSE)
+        assert healed.breaker_snapshot()["state"] == "open"
+        faults.set_plan(None)
+        healed.probe()
+        assert healed.breaker_snapshot()["state"] == "closed"
+        healed.render("img", POSE)  # circuit closed: requests flow again
+    finally:
+        healed.close()
+        srv.drain(reason="test")
+
+
+# ---------------- keep-alive + stale reconnect (satellite 1) ----------
+
+def test_keepalive_reuses_connection():
+    srv, fleet = _server()
+    client = HostClient(f"127.0.0.1:{srv.port}")  # policy OFF
+    try:
+        client.render("img", POSE)
+        conn = client._local.conn
+        assert conn is not None and conn.sock is not None
+        client.render("img", POSE)
+        assert client._local.conn is conn  # same kept-alive connection
+        assert client.reconnects == 0 and fleet.submits == 2
+    finally:
+        client.close()
+        srv.drain(reason="test")
+
+
+def test_stale_keepalive_heals_with_one_reconnect():
+    """A server that closes the kept-alive socket between requests (a
+    restart, an idle-timeout proxy) costs ONE transparent counted
+    reconnect, policy OFF or on — never a caller-visible error."""
+    import socket as socketlib
+
+    body = b'{"ok": true}'
+    resp = (b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            + b"Content-Length: %d\r\n\r\n" % len(body) + body)
+    lsock = socketlib.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(2)
+    port = lsock.getsockname()[1]
+    closed_first = threading.Event()
+
+    def run():
+        for i in range(2):
+            c, _ = lsock.accept()
+            c.recv(65536)
+            c.sendall(resp)
+            c.close()  # the server drops the kept-alive connection
+            if i == 0:
+                closed_first.set()
+        lsock.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    client = HostClient(f"127.0.0.1:{port}")  # policy OFF: still healed
+    try:
+        assert client.healthz() == {"ok": True}
+        assert closed_first.wait(timeout=10)
+        # the client still holds the (now stale) kept-alive socket
+        assert client._local.conn is not None
+        assert client._local.conn.sock is not None
+        assert client.healthz() == {"ok": True}
+        assert client.reconnects == 1
+        t.join(timeout=10)
+    finally:
+        client.close()
+
+
+def test_per_thread_connections_are_distinct():
+    srv, _ = _server()
+    client = HostClient(f"127.0.0.1:{srv.port}")
+    conns = {}
+    try:
+        def hit(name):
+            client.render("img", POSE)
+            conns[name] = client._local.conn
+        hit("main")
+        t = threading.Thread(target=hit, args=("worker",))
+        t.start()
+        t.join()
+        assert conns["main"] is not conns["worker"]
+    finally:
+        client.close()
+        srv.drain(reason="test")
+
+
+# ---------------- deadline propagation (satellite 4) ------------------
+
+def test_deadline_expired_in_front_never_reaches_the_host():
+    ring = HostRing()
+    ring.join("n0")
+    fleet = _StubFleet()
+
+    class _Handle:
+        def render(self, image_id, pose, tier=None, deadline_ms=None,
+                   image=None):
+            return fleet.submit(image_id, pose, tier=tier,
+                                deadline_ms=deadline_ms).result()
+
+        def healthz(self):
+            return {"status": "ok"}
+
+    policy = NetPolicy(enabled=True)
+    front = RingFront(ring, {"n0": _Handle()}, workers=1, policy=policy)
+    clock = [0.0]
+    front._now = lambda: clock[0]
+    try:
+        t0 = front._now()
+        clock[0] = 1.0  # 1000ms elapse while the request sits queued
+        with pytest.raises(DeadlineExceeded):
+            front._route_one("img", POSE, None, 50.0, None, t0)
+        assert front.front_expired == 1 and fleet.submits == 0
+        # a live budget flows through, shrunk to what is LEFT
+        clock[0] = 1.01
+        front._route_one("img", POSE, None, 50.0, None, 1.0)
+        assert fleet.submits == 1
+        assert fleet.deadlines[0] == pytest.approx(40.0)
+    finally:
+        front.close()
+
+
+def test_server_sweeps_expired_deadline_header_before_the_batcher():
+    srv, fleet = _server()
+    try:
+        body = {"image_id": "img", "pose": POSE.reshape(-1).tolist(),
+                "tier": None, "deadline_ms": None, "image": None}
+        code, obj = srv._handle_render(body, deadline_left_ms=0.0)
+        assert code == 504 and obj["kind"] == "DeadlineExceeded"
+        assert srv.swept == 1 and fleet.submits == 0
+        # a live header budget reaches the batcher as the deadline
+        code, obj = srv._handle_render(dict(body), deadline_left_ms=25.0)
+        assert code == 200 and fleet.deadlines == [25.0]
+        # the tighter of (request's own, header) wins
+        body["deadline_ms"] = 10.0
+        srv._handle_render(body, deadline_left_ms=25.0)
+        assert fleet.deadlines[-1] == 10.0
+    finally:
+        srv.drain(reason="test")
+
+
+def test_deadline_header_crosses_the_wire():
+    srv, fleet = _server()
+    policy = NetPolicy(enabled=True, retries=0)
+    client = HostClient(f"127.0.0.1:{srv.port}", policy=policy,
+                        net_src="t", net_name="n0")
+    try:
+        client.render("img", POSE, deadline_ms=60000.0)
+        # the header budget (60s minus wire time) reached the batcher
+        assert fleet.deadlines[0] is not None
+        assert 0 < fleet.deadlines[0] <= 60000.0
+        assert srv.swept == 0
+    finally:
+        client.close()
+        srv.drain(reason="test")
+
+
+# ---------------- heartbeat failure detector --------------------------
+
+class _ProbeHost:
+    """Scriptable handle: healthz raises this host's current failure."""
+
+    def __init__(self):
+        self.fail_with = None
+        self.render_fail = None
+
+    def render(self, image_id, pose, tier=None, deadline_ms=None,
+               image=None):
+        if self.render_fail is not None:
+            raise self.render_fail
+        return ("ok", image_id)
+
+    def healthz(self):
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"status": "ok"}
+
+
+def _detector_front(policy=None, hosts=("a", "b")):
+    ring = HostRing()
+    handles = {}
+    for h in hosts:
+        ring.join(h)
+        handles[h] = _ProbeHost()
+    policy = policy or NetPolicy(enabled=True, suspect_misses=2,
+                                 dead_misses=4, revive_probes=2)
+    return RingFront(ring, handles, workers=1, policy=policy), ring, handles
+
+
+def test_probe_misses_suspect_then_revive(event_stream):
+    front, ring, handles = _detector_front()
+    try:
+        handles["b"].fail_with = TimeoutError("slow")
+        front.probe_once()
+        assert front.suspects() == []        # miss 1 of 2
+        front.probe_once()
+        assert front.suspects() == ["b"]     # suspect: routed around...
+        assert ring.state("b") == "alive"    # ...membership untouched
+        key_b = "ffffffffx"                  # slot owner: b
+        assert front.render(key_b, None) == ("ok", key_b)
+        assert front.route_split()["a"] == [0, 1]  # a took b's key
+        handles["b"].fail_with = None
+        front.probe_once()
+        assert front.suspects() == ["b"]     # ok 1 of revive_probes=2
+        front.probe_once()
+        assert front.suspects() == []        # hysteresis cleared it
+        trail = [(e["state"], e["host"]) for e in
+                 _events(event_stream, "serve.host_suspect")]
+        assert trail == [("suspect", "b"), ("alive", "b")]
+        assert front.net_stats()["probe_misses"] == 2
+    finally:
+        front.close()
+
+
+def test_only_sustained_refusal_marks_dead(event_stream):
+    front, ring, handles = _detector_front()
+    try:
+        # timeouts forever: SUSPECT, never dead (a slow link is not a
+        # vanished host)
+        handles["b"].fail_with = TimeoutError("slow")
+        for _ in range(10):
+            front.probe_once()
+        assert ring.state("b") == "alive" and front.suspects() == ["b"]
+        # refusals are evidence nothing is listening: dead_misses
+        # consecutive ones take the authoritative membership edge
+        handles["b"].fail_with = ConnectionRefusedError("gone")
+        for _ in range(4):
+            front.probe_once()
+        assert ring.state("b") == "dead"
+        assert front.suspects() == []  # graduated out of suspicion
+        states = [e["state"] for e in
+                  _events(event_stream, "serve.host_suspect")]
+        assert states == ["suspect", "dead"]
+    finally:
+        front.close()
+
+
+def test_request_timeout_suspects_and_fails_over():
+    """Satellite: the front distinguishes a TIMEOUT (suspect, route
+    around, host stays a member) from CONNECTION REFUSED (dead)."""
+    front, ring, handles = _detector_front()
+    try:
+        key_b = "ffffffffx"
+        handles["b"].render_fail = TimeoutError("slow render")
+        assert front.render(key_b, None) == ("ok", key_b)  # a served it
+        assert ring.state("b") == "alive"
+        assert front.suspects() == ["b"]
+        handles["a"].render_fail = ConnectionRefusedError("gone")
+        key_a = "00000000x"
+        # a is dead; b is suspect but the ONLY alive member — a suspect
+        # beats nothing, so b still serves
+        handles["b"].render_fail = None
+        assert front.render(key_a, None) == ("ok", key_a)
+        assert ring.state("a") == "dead"
+    finally:
+        front.close()
+
+
+def test_breaker_open_suspects_not_dead():
+    front, ring, handles = _detector_front()
+    try:
+        handles["b"].render_fail = BreakerOpen("circuit open")
+        key_b = "ffffffffx"
+        assert front.render(key_b, None) == ("ok", key_b)
+        assert ring.state("b") == "alive" and front.suspects() == ["b"]
+    finally:
+        front.close()
+
+
+def test_prober_thread_lifecycle():
+    policy = NetPolicy(enabled=True, probe_interval_s=30.0)
+    front, _, _ = _detector_front(policy=policy)
+    names = [t.name for t in threading.enumerate()]
+    assert "mine-tpu-ring-prober" in names
+    front.close()
+    assert not any(t.name == "mine-tpu-ring-prober" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ---------------- partition safety (gated in verify_tier1.sh) ---------
+
+def _partitioned_world():
+    """Two stub-fleet hosts behind REAL HostServers; three fronts — two
+    'inside' fronts each cut off from ONE host, one external front that
+    reaches both. Suspicion must stay front-local."""
+    servers = []
+    for host_id in ("n0", "n1"):
+        srv, _ = _server(host_id=host_id)
+        servers.append(srv)
+    policy = NetPolicy(enabled=True, retries=0, suspect_misses=2,
+                       dead_misses=1000, revive_probes=2)
+    fronts = {}
+    for src in ("ext", "h1", "h2"):
+        ring = HostRing()
+        handles = {}
+        for host_id, srv in zip(("n0", "n1"), servers):
+            ring.join(host_id)
+            handles[host_id] = HostClient(
+                f"127.0.0.1:{srv.port}", policy=policy, net_src=src,
+                net_name=host_id)
+        fronts[src] = RingFront(ring, handles, workers=1, policy=policy)
+    return servers, fronts
+
+
+KEYS = ["%08x" % ((i * 2654435761) % (1 << 32)) for i in range(64)]
+
+
+def test_partition_one_alive_owner_per_key():
+    """Under an asymmetric partition (h1 can't reach n1, h2 can't reach
+    n0) every front still resolves EXACTLY ONE alive owner per key, no
+    front writes membership (no split-brain), and the unpartitioned
+    front keeps serving through both hosts."""
+    servers, fronts = _partitioned_world()
+    try:
+        faults.set_plan(faults.FaultPlan(net_partition="h1>n1,h2>n0"))
+        for _ in range(2):  # suspect_misses rounds of heartbeats
+            fronts["h1"].probe_once()
+            fronts["h2"].probe_once()
+            fronts["ext"].probe_once()
+        assert fronts["h1"].suspects() == ["n1"]
+        assert fronts["h2"].suspects() == ["n0"]
+        assert fronts["ext"].suspects() == []
+        for name, front in fronts.items():
+            # membership is SINGLE-WRITER: suspicion never wrote it
+            assert [s for _, s in front.ring.members()] == \
+                ["alive", "alive"], name
+            # the covering property holds per view: one owner per key
+            avoid = frozenset(front.suspects())
+            owners = {k: front.ring.owner(k, avoid=avoid) for k in KEYS}
+            assert set(owners.values()) <= {"n0", "n1"}
+        # the partitioned fronts route around their severed host…
+        avoid1 = frozenset(fronts["h1"].suspects())
+        assert {fronts["h1"].ring.owner(k, avoid=avoid1)
+                for k in KEYS} == {"n0"}
+        # …while the external front still spreads over both
+        assert {fronts["ext"].ring.owner(k) for k in KEYS} == {"n0", "n1"}
+        for k in KEYS[:8]:
+            rgb, _ = fronts["ext"].render(k, POSE)
+            assert rgb.shape == (2, 2, 3)
+        assert fronts["ext"].failures == 0
+    finally:
+        faults.set_plan(None)
+        for front in fronts.values():
+            front.close()
+        for srv in servers:
+            srv.drain(reason="test")
+
+
+def test_partition_heal_reconverges():
+    """Healing the partition clears every front-local suspicion after
+    `revive_probes` clean heartbeats, and all fronts' owner maps
+    re-converge to the identical pre-partition mapping."""
+    servers, fronts = _partitioned_world()
+    try:
+        baseline = {k: fronts["ext"].ring.owner(k) for k in KEYS}
+        faults.set_plan(faults.FaultPlan(net_partition="h1>n1,h2>n0"))
+        for _ in range(2):
+            fronts["h1"].probe_once()
+            fronts["h2"].probe_once()
+        assert fronts["h1"].suspects() and fronts["h2"].suspects()
+        faults.set_plan(None)  # the link heals
+        for _ in range(2):  # revive_probes clean rounds
+            fronts["h1"].probe_once()
+            fronts["h2"].probe_once()
+        for name, front in fronts.items():
+            assert front.suspects() == [], name
+            avoid = frozenset(front.suspects())
+            assert {k: front.ring.owner(k, avoid=avoid)
+                    for k in KEYS} == baseline, name
+    finally:
+        faults.set_plan(None)
+        for front in fronts.values():
+            front.close()
+        for srv in servers:
+            srv.drain(reason="test")
+
+
+# ---------------- config + faults plumbing ----------------------------
+
+def test_net_config_defaults_off_and_validation():
+    cfg = serve_config_from_dict({})
+    assert cfg.net_enabled is False
+    assert cfg.net_retries == 2 and cfg.net_probe_interval_s == 0.0
+    on = serve_config_from_dict({
+        "serve.net.enabled": True, "serve.net.retries": 5,
+        "serve.net.probe_interval_s": 0.5,
+        "serve.net.suspect_misses": 2})
+    assert on.net_enabled and on.net_retries == 5
+    assert on.net_suspect_misses == 2
+    for key, bad, msg in (
+            ("serve.net.connect_timeout_s", 0, "connect_timeout_s"),
+            ("serve.net.read_timeout_s", -1, "read_timeout_s"),
+            ("serve.net.retries", -1, "retries"),
+            ("serve.net.backoff_ms", -1, "backoff_ms"),
+            ("serve.net.breaker_threshold", 0, "breaker_threshold"),
+            ("serve.net.breaker_reset_s", -1, "breaker_reset_s"),
+            ("serve.net.probe_interval_s", -1, "probe_interval_s"),
+            ("serve.net.suspect_misses", 0, "suspect_misses"),
+            ("serve.net.dead_misses", 0, "dead_misses"),
+            ("serve.net.revive_probes", 0, "revive_probes")):
+        with pytest.raises(ValueError, match=msg):
+            serve_config_from_dict({key: bad})
+
+
+def test_fault_spec_coerces_by_field_type():
+    plan = faults.plan_from_spec({"net_partition": "h1>n1",
+                                  "net_latency_ms": "3"})
+    assert plan.net_partition == "h1>n1"  # str field passes verbatim
+    assert plan.net_latency_ms == 3       # int field coerced
+    assert plan.active
+    assert faults.plan_from_spec({}) is None
+
+
+def test_net_off_constructs_nothing():
+    client = HostClient("127.0.0.1:1")
+    assert client.policy is None and client.breaker is None
+    assert client.breaker_snapshot() is None
+    off = HostClient("127.0.0.1:1", policy=NetPolicy())  # enabled=False
+    assert off.policy is None and off.breaker is None
+    ring = HostRing()
+    ring.join("a")
+    front = RingFront(ring, {"a": _ProbeHost()}, workers=1)
+    try:
+        assert front.policy is None and front._prober is None
+        assert "net" not in front.stats()
+        assert "net" not in front.health()
+        assert not any(t.name == "mine-tpu-ring-prober"
+                       for t in threading.enumerate() if t.is_alive())
+    finally:
+        front.close()

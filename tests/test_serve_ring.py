@@ -13,7 +13,9 @@ The load-bearing contracts, each asserted here:
   * `RingFront` routes to the alive owner, fails over ring-wise when a
     handle raises `HostUnavailable` (draining) or a connection error
     (dead), counts owner-hits vs remote-routes per host, and raises only
-    when no member is left;
+    when no member is left — and (PR 19) a TIMEOUT only SUSPECTS the
+    host (front-local, heals on success) while CONNECTION REFUSED takes
+    the authoritative mark_dead edge;
   * the `Autoscaler` is hysteretic: `evals` CONSECUTIVE high readings
     grow, `evals` consecutive low readings shrink, the deadband resets
     both streaks, cooldown holds after every action, min/max bound the
@@ -171,6 +173,36 @@ def test_front_routes_to_owner_and_counts():
     assert front.remote_route_fraction() == 0.0
     assert front.health()["status"] == "ok"
     front._pool.shutdown(wait=True)
+
+
+def test_front_timeout_suspects_refused_kills():
+    """Failover distinguishes a TIMEOUT (slow link or host — front-local
+    suspicion, membership untouched, heals on success) from CONNECTION
+    REFUSED (nothing listening — the authoritative mark_dead edge).
+    PR-19 wire hardening; the split holds with or without a NetPolicy."""
+    key = "00000000x"  # slot owner: a
+    ring = _ring(("a", "b"))
+    handles = {"a": _StubHost("a"), "b": _StubHost("b")}
+    front = RingFront(ring, handles, workers=2)
+    handles["a"].fail_with = TimeoutError("slow render")
+    assert front.render(key, None) == ("b", key)
+    assert ring.state("a") == "alive"  # suspect, NOT dead
+    assert front.suspects() == ["a"]
+    # the host answers again: a routed success clears the suspicion
+    # (no prober configured, so request successes are the revive path)
+    handles["a"].fail_with = None
+    handles["b"].fail_with = HostUnavailable("draining")
+    assert front.render(key, None) == ("a", key)
+    assert front.suspects() == []
+    front._pool.shutdown(wait=True)
+
+    ring2 = _ring(("a", "b"))
+    handles2 = {"a": _StubHost("a"), "b": _StubHost("b")}
+    front2 = RingFront(ring2, handles2, workers=2)
+    handles2["a"].fail_with = ConnectionRefusedError("gone")
+    assert front2.render(key, None) == ("b", key)
+    assert ring2.state("a") == "dead" and front2.suspects() == []
+    front2._pool.shutdown(wait=True)
 
 
 def test_front_fails_over_ringwise_and_marks_members():

@@ -94,6 +94,13 @@ LEVERS = [
     # variant return the "skipped: unknown variant" string, which the
     # conductor reads as a neutral verdict
     {"name": "serve_multihost"},
+    # flaky-link lever: the 2-host ring flooded through policy-armed
+    # clients (serve.net.* retry/breaker/keep-alive) while faults.py
+    # injects latency + every-4th drops; the keyed ips is GOODPUT (ok
+    # views/s), pricing what the wire hardening holds on a lossy link.
+    # Rides the same unknown-variant skip as serve_multihost on bench
+    # builds predating the variant
+    {"name": "serve_multihost_flaky"},
 ]
 
 PROMOTE_AT = 1.05

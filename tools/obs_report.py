@@ -250,6 +250,39 @@ def report(events, log_lines):
                            % (e.get("host"), e.get("owner_hits"),
                               e.get("remote_routes")))
 
+    breakers = [e for e in events if e.get("kind") == "serve.breaker"]
+    suspects = [e for e in events
+                if e.get("kind") == "serve.host_suspect"]
+    if breakers or suspects:
+        out.append("")
+        out.append("network health (wire hardening, serve.net.*):")
+        if breakers:
+            # per-host breaker transition trail; the ones that matter in
+            # a postmortem are the opens (each also arms the recorder)
+            by_host = TallyCounter(e.get("host") for e in breakers)
+            opens = sum(1 for e in breakers if e.get("state") == "open")
+            out.append("  breaker transitions: %d (%d open) across "
+                       "%d host(s)" % (len(breakers), opens, len(by_host)))
+            for e in breakers:
+                out.append("    %-12s -> %-9s failures=%s"
+                           % (e.get("host"), e.get("state"),
+                              e.get("failures")))
+        if suspects:
+            out.append("  failure detector (suspect = routed around, "
+                       "membership untouched):")
+            for e in suspects:
+                out.append("    %-12s -> %-8s misses=%s"
+                           % (e.get("host"), e.get("state"),
+                              e.get("misses")))
+            unresolved = {}
+            for e in suspects:
+                unresolved[e.get("host")] = e.get("state")
+            still = sorted(h for h, s in unresolved.items()
+                           if s == "suspect")
+            if still:
+                out.append("  still suspect at stream end: %s"
+                           % ", ".join(still))
+
     admissions = [e for e in events if e.get("kind") == "serve.admission"]
     deaths = [e for e in events if e.get("kind") == "serve.shard_dead"]
     revives = [e for e in events if e.get("kind") == "serve.shard_revive"]
@@ -516,6 +549,18 @@ def report_json(events, log_lines):
                                               "to_hosts", "routes")}
                        for e in events
                        if e.get("kind") == "serve.ring_rebalance"],
+    }
+
+    # wire hardening (serve.net.*): the breaker transition trail and the
+    # failure detector's suspect/alive/dead verdicts, in stream order
+    out["net"] = {
+        "breakers": [{k: e.get(k) for k in ("ts", "host", "state",
+                                            "failures")}
+                     for e in events if e.get("kind") == "serve.breaker"],
+        "suspects": [{k: e.get(k) for k in ("ts", "host", "state",
+                                            "misses")}
+                     for e in events
+                     if e.get("kind") == "serve.host_suspect"],
     }
 
     out["slo_breaches"] = [

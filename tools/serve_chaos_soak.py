@@ -23,6 +23,18 @@ in mine_tpu/testing/faults.py — never by monkeypatching serve code:
             re-encode from the pixels riding each interpolated request —
             zero failed frames, and strictly more sync encodes than the
             healthy ceil(frames/K).
+  flaky_link  FaultPlan(net_latency_ms, net_drop_every, net_truncate_times)
+            against policy-armed HostClients (serve.net.*): the bounded
+            retry + stale-reconnect paths must absorb every injected
+            drop and truncation — zero critical failures, with retry
+            counters proving the chaos actually bit.
+  partition an asymmetric partition matrix (net_partition="h1>n1,h2>n0")
+            across three RingFronts over the same two hosts: suspicion
+            stays FRONT-LOCAL (membership is single-writer), every view
+            resolves exactly one alive owner per key (no split-brain),
+            the unpartitioned front keeps serving, and the heal
+            re-converges all owner maps after revive_probes clean
+            heartbeats.
   hosts     the multi-host ring (serve/ring.py + hostnet.py, --hosts N,
             0 skips): ONE packed AOT artifact is built in a subprocess
             (hostnet --build-artifact), N hosts boot from it — each must
@@ -48,6 +60,11 @@ exits NONZERO if any invariant breaks:
     or any failed request;
   * the session phase drops a frame, fails to re-encode after the owner
     kill, or ends with the session table non-empty;
+  * the flaky-link phase leaks a single failure to the critical tier, or
+    finishes with zero retries (the injection never bit);
+  * the partition phase sees a front write ring membership, a key with
+    no alive owner in any view, suspicion on the unpartitioned front,
+    or an owner map that fails to re-converge after the heal;
   * the hosts phase boots a host with live compiles, lets a critical
     request fail through the SIGTERM, leaves the killed host's key range
     uncovered, oscillates the autoscale trail, or loses the incident
@@ -307,6 +324,151 @@ def run_hosts_phase(args, check, events_path):
         front.close()  # emits the final ring_rebalance with the routes
 
 
+def run_net_phases(args, check):
+    """Wire-hardening phases (PR 19, serve.net.*): a flaky link the
+    hardened client must absorb invisibly, then an asymmetric partition
+    the failure detector must route around without split-brain.
+
+    Everything is in-process — two tiny ServeFleets behind REAL
+    HostServers, reached through policy-armed HostClients — and every
+    failure is injected through the transport seams in testing/faults.py
+    (net_request/net_truncate), never by monkeypatching hostnet."""
+    from mine_tpu.serve import (HostClient, HostRing, HostServer, NetPolicy,
+                                RingFront, ServeFleet)
+    from mine_tpu.serve.admission import TIER_CRITICAL
+    from mine_tpu.testing import faults
+    from mine_tpu.testing.faults import FaultPlan
+
+    fleets = {h: ServeFleet(cache_shards=1, max_requests=8, max_wait_ms=2.0,
+                            max_bucket=8, encode_fn=_encode_fn, ops_port=0)
+              for h in ("n0", "n1")}
+    servers = {h: HostServer(fleets[h], h).start() for h in fleets}
+    try:
+        # ---- phase: flaky_link ----
+        # latency + a deterministic every-3rd mid-request drop + two
+        # truncated responses: the bounded retry and stale-reconnect
+        # paths must absorb ALL of it — zero critical failures, and the
+        # retry counters prove the injection actually bit
+        policy = NetPolicy(enabled=True, connect_timeout_s=5.0,
+                           read_timeout_s=args.timeout_s, retries=3,
+                           backoff_ms=2.0, breaker_threshold=5,
+                           breaker_reset_s=0.2)
+        ring = HostRing()
+        handles = {}
+        for h in servers:
+            ring.join(h)
+            handles[h] = HostClient(f"127.0.0.1:{servers[h].port}",
+                                    policy=policy, net_src="front",
+                                    net_name=h)
+        front = RingFront(ring, handles, policy=policy)
+        try:
+            nf_keys = [_key(i % 2, 2, f"net{i}")
+                       for i in range(args.host_flood)]
+            nf_imgs = {k: _image(300 + i) for i, k in enumerate(nf_keys)}
+            faults.set_plan(FaultPlan(net_latency_ms=2, net_drop_every=3,
+                                      net_truncate_times=2))
+            futs = [(TIER_CRITICAL,
+                     front.submit(k, POSE, tier=TIER_CRITICAL,
+                                  image=nf_imgs[k])) for k in nf_keys]
+            outcomes = _settle(futs, args.timeout_s)
+            faults.set_plan(None)
+            bad = [v for _, v in outcomes if v != "ok"]
+            check(not bad,
+                  f"flaky link leaked failures to critical tier: {bad}")
+            retries = sum(c.retries for c in handles.values())
+            reconnects = sum(c.reconnects for c in handles.values())
+            check(retries > 0,
+                  "flaky-link phase produced no client retries (the "
+                  "injection did not bite — the harness lost its teeth)")
+            print(f"phase=flaky_link requests={len(futs)} failures=0 "
+                  f"retries={retries} reconnects={reconnects} "
+                  f"front_failures={front.failures}", flush=True)
+        finally:
+            faults.set_plan(None)
+            front.close()
+
+        # ---- phase: partition ----
+        # asymmetric split: front h1 cannot reach host n1, front h2
+        # cannot reach host n0, the external front reaches both.
+        # Suspicion must stay FRONT-LOCAL (membership single-writer), so
+        # every view still resolves exactly one alive owner per key —
+        # the no-split-brain property — and the heal re-converges all
+        # owner maps to the pre-partition baseline
+        policy_p = NetPolicy(enabled=True, retries=0, suspect_misses=2,
+                             dead_misses=1000, revive_probes=2)
+        fronts = {}
+        for src in ("ext", "h1", "h2"):
+            ring = HostRing()
+            handles = {}
+            for h in servers:
+                ring.join(h)
+                handles[h] = HostClient(f"127.0.0.1:{servers[h].port}",
+                                        policy=policy_p, net_src=src,
+                                        net_name=h)
+            fronts[src] = RingFront(ring, handles, workers=2,
+                                    policy=policy_p)
+        p_keys = [_key(s, 16, f"part{s}") for s in range(16)]
+        p_imgs = {k: _image(400 + i) for i, k in enumerate(p_keys)}
+        try:
+            baseline = {k: fronts["ext"].ring.owner(k) for k in p_keys}
+            faults.set_plan(FaultPlan(net_partition="h1>n1,h2>n0"))
+            for _ in range(2):  # suspect_misses rounds of heartbeats
+                for f in fronts.values():
+                    f.probe_once()
+            check(fronts["h1"].suspects() == ["n1"],
+                  f"h1 suspicion wrong: {fronts['h1'].suspects()}")
+            check(fronts["h2"].suspects() == ["n0"],
+                  f"h2 suspicion wrong: {fronts['h2'].suspects()}")
+            check(fronts["ext"].suspects() == [],
+                  f"unpartitioned front caught suspicion: "
+                  f"{fronts['ext'].suspects()}")
+            for name, f in fronts.items():
+                check([s for _, s in f.ring.members()] ==
+                      ["alive", "alive"],
+                      f"front {name} wrote membership under partition "
+                      f"(split-brain): {f.ring.members()}")
+                avoid = frozenset(f.suspects())
+                owners = {k: f.ring.owner(k, avoid=avoid) for k in p_keys}
+                check(set(owners.values()) <= {"n0", "n1"},
+                      f"front {name} resolved a non-member owner: "
+                      f"{set(owners.values())}")
+            # the unpartitioned front must keep SERVING through both
+            ext_futs = [(TIER_CRITICAL,
+                         fronts["ext"].submit(k, POSE, tier=TIER_CRITICAL,
+                                              image=p_imgs[k]))
+                        for k in p_keys[:8]]
+            ext_out = _settle(ext_futs, args.timeout_s)
+            check(all(v == "ok" for _, v in ext_out),
+                  f"external front failed through the partition: {ext_out}")
+            # heal: revive_probes clean heartbeats clear every suspicion
+            faults.set_plan(None)
+            for _ in range(2):
+                for f in fronts.values():
+                    f.probe_once()
+            for name, f in fronts.items():
+                check(f.suspects() == [],
+                      f"front {name} still suspect after heal: "
+                      f"{f.suspects()}")
+                owners = {k: f.ring.owner(k) for k in p_keys}
+                check(owners == baseline,
+                      f"front {name} owner map did not re-converge "
+                      f"after heal")
+            print(f"phase=partition keys={len(p_keys)} "
+                  f"served={sum(v == 'ok' for _, v in ext_out)} "
+                  f"suspects_h1=n1 suspects_h2=n0 healed=1 "
+                  f"probe_misses="
+                  f"{sum(f.probe_misses for f in fronts.values())}",
+                  flush=True)
+        finally:
+            faults.set_plan(None)
+            for f in fronts.values():
+                f.close()
+    finally:
+        faults.set_plan(None)
+        for srv in servers.values():
+            srv.drain(reason="soak")  # drain closes the fleet too
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="serve-side chaos soak (overload + shard failover)")
@@ -507,6 +669,9 @@ def main():
               f"re_encodes={extra} "
               f"keyframes={session.stats()['keyframes']}", flush=True)
 
+        # ---- phases: flaky_link + partition (wire hardening) ----
+        run_net_phases(args, check)
+
         # ---- phase: hosts (multi-host ring: kill + autoscale) ----
         if args.hosts > 0:
             run_hosts_phase(args, check, events_path)
@@ -524,7 +689,8 @@ def main():
     kinds = {e["kind"] for e in tevents.read_events(events_path)}
     expected = ["serve.admission", "serve.shard_dead", "serve.shard_revive",
                 "serve.session_start", "serve.session_keyframe",
-                "serve.session_frame", "serve.session_end", "obs.incident"]
+                "serve.session_frame", "serve.session_end",
+                "serve.host_suspect", "obs.incident"]
     if args.hosts > 0:
         expected += ["serve.host_join", "serve.host_drain",
                      "serve.autoscale", "serve.ring_rebalance"]

@@ -93,6 +93,24 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# the partition-safety property is tier-1 in its own right (same
+# rationale as the pipeline gate above: the wall-capped window can
+# truncate before test_serve_net.py on a slow box): under an asymmetric
+# partition every front must resolve exactly ONE alive owner per key
+# with membership single-writer (no split-brain), and the heal must
+# re-converge every owner map — a ring/hostnet change that breaks
+# either fails tier-1 even when the window axed the suite
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_serve_net.py::test_partition_one_alive_owner_per_key" \
+        "tests/test_serve_net.py::test_partition_heal_reconverges" \
+        -q -p no:cacheprovider -p no:randomly \
+        > /tmp/_t1_partition.txt 2>&1; then
+    tail -20 /tmp/_t1_partition.txt
+    echo "PARTITION: split-brain/heal property gate failed (output in" \
+         "/tmp/_t1_partition.txt)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # the incident-bundle capture/read contract is tier-1: postmortem's
 # selftest pushes a synthetic incident through the REAL FlightRecorder
 # dump path, renders it, and asserts a corrupted copy is rejected — so a

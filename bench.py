@@ -233,9 +233,10 @@ VARIANTS = {
     # hosts, no checkpoint): 2 -> 3 -> 4 hostnet processes boot from ONE
     # packed AOT artifact — every host must join with zero live compiles
     # — and a RingFront floods renders at each ring size. Aggregate
-    # views/s + remote-route fraction per host count as one parseable
-    # stderr line ("serve_multihost curve: H:views_per_sec:remote_frac
-    # ..."), plus a failover reading with one member drained so the
+    # views/s + remote-route fraction + payload bytes/view per host
+    # count as one parseable stderr line ("serve_multihost curve:
+    # H:views_per_sec:remote_frac:bytes_per_view ..."), plus a failover
+    # reading with one member drained so the
     # remote fraction is exercised, not just reported as zero. JSON ips
     # = views/s at the largest healthy ring; checkouts predating the
     # variant skip the row through the unknown-variant path, which the
@@ -251,6 +252,19 @@ VARIANTS = {
     # goodput; checkouts predating serve.net.* skip the row through the
     # same unknown-variant path the conductor reads as neutral.
     "serve_multihost_flaky": (1, {}),
+    # BINARY-WIRE arm of the multi-host row (serve.wire.*): the same
+    # 2-host ring flood swept over codec json -> bin_f32 -> bin_int8,
+    # binary arms riding mtpu-wire1 frames + the front's owner-coalescer.
+    # Reading per arm: views/s, measured payload bytes/view (client
+    # tx+rx deltas over the flood) and retry rate, as one parseable
+    # stderr line ("serve_multihost_wire curve:
+    # codec:views_per_sec:bytes_per_view:retry_rate ...") plus a pinned
+    # serve.wire_point event per arm. The row asserts the tentpole's
+    # claim: bin_int8 + coalescing moves >= 3x fewer bytes/view than
+    # JSON/base64 with zero failed requests. JSON ips = bin_int8
+    # views/s; checkouts predating serve.wire.* skip the row through the
+    # same unknown-variant path the conductor reads as neutral.
+    "serve_multihost_wire": (1, {}),
     # SSIM-PRECISION A/B row: two losspass measurements over the same
     # program, training.ssim_precision=highest (shipped default, exact-f32
     # blur einsums) vs default (platform precision — bf16 MXU on TPU).
@@ -1335,7 +1349,11 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
     The serve_multihost_flaky variant reuses the same boot path with a
     2-host ring and policy-armed clients, floods through injected
     latency + drops, and reports GOODPUT and retry rate instead of the
-    curve (see VARIANTS)."""
+    curve; serve_multihost_wire boots the hosts with `--wire binary`
+    and sweeps the flood over codec json -> bin_f32 -> bin_int8 (binary
+    arms with the owner-coalescer armed), reporting views/s +
+    bytes/view + retry rate per codec and asserting the >= 3x bin_int8
+    byte cut (see VARIANTS)."""
     import subprocess
     import tempfile
 
@@ -1345,8 +1363,8 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
 
     repo = os.path.dirname(os.path.abspath(__file__))
     counts = SERVE_MULTIHOST_COUNTS[:2] if SMOKE else SERVE_MULTIHOST_COUNTS
-    if name.endswith("_flaky"):
-        counts = SERVE_MULTIHOST_COUNTS[:1]  # the LINK is under test
+    if name.endswith("_flaky") or name.endswith("_wire"):
+        counts = SERVE_MULTIHOST_COUNTS[:1]  # the LINK/WIRE is under test
     n_req = 24 if SMOKE else 128
     n_keys = 8
     workdir = tempfile.mkdtemp(prefix="mtpu_multihost_bench_")
@@ -1388,7 +1406,9 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
                            "--aot-artifact", artifact,
                            "--warm-key", warm_key,
                            "--warm-seed", str(warm_seed),
-                           "--drain-timeout-s", "5"],
+                           "--drain-timeout-s", "5"]
+                + (["--wire", "binary"]
+                   if name.endswith("_wire") else []),
                 env=env, cwd=repo, stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL, text=True, bufsize=1)
             procs[hid] = p
@@ -1415,7 +1435,10 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
         pose = np.eye(4, dtype=np.float32)
         keys = ["%08x" % ((s * 2 ** 32) // n_keys + 1) + "bench%d" % s
                 for s in range(n_keys)]
-        imgs = {k: np.full((8, 8, 3), 40.0 + i, np.float32)
+        # 32x32 uploads so the wire arms measure payload movement, not
+        # frame-header overhead (synthetic_encode_fn only folds img.sum()
+        # into its seed, so upload geometry is free to differ from SYN_HW)
+        imgs = {k: np.full((32, 32, 3), 40.0 + i, np.float32)
                 for i, k in enumerate(keys)}
 
         def flood(front, n):
@@ -1483,10 +1506,67 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
                                front.remote_route_fraction(), 4))
             return goodput, None, None, 1
 
+        if name.endswith("_wire"):
+            # binary-wire arm: codec sweep over the same flood, with
+            # fresh clients per arm so the bytes/view ledger is a clean
+            # per-codec delta. Binary arms add the front's
+            # owner-coalescer (linger window + full-bucket flush); the
+            # json arm uses plain clients against the SAME advertising
+            # hosts, so only the client's policy differs.
+            from mine_tpu import telemetry
+            from mine_tpu.serve import WirePolicy
+            H = counts[-1]
+            arms = []
+            for codec in ("json", "bin_f32", "bin_int8"):
+                wp = None
+                if codec != "json":
+                    wp = WirePolicy(format="binary", codec=codec[4:],
+                                    coalesce_ms=5.0, coalesce_max=8)
+                clients = {hid: HostClient(handles[hid].address,
+                                           timeout_s=300.0,
+                                           wire_policy=wp)
+                           for hid in list(handles)[:H]}
+                ring = HostRing()
+                front = RingFront(ring, {}, wire=wp)
+                for hid, c in clients.items():
+                    front.add_host(hid, c)
+                try:
+                    # warm-up also settles negotiation, so the measured
+                    # window is frames-only
+                    flood(front, max(n_req // 4, n_keys))
+                    b0 = sum(c.bytes_tx + c.bytes_rx
+                             for c in clients.values())
+                    vps = flood(front, n_req)
+                    moved = sum(c.bytes_tx + c.bytes_rx
+                                for c in clients.values()) - b0
+                finally:
+                    front.close()
+                bpv = moved / n_req
+                retries = sum(c.retries for c in clients.values())
+                arms.append((codec, vps, bpv, retries / n_req))
+                telemetry.emit("serve.wire_point", codec=codec,
+                               views_per_sec=round(vps, 3),
+                               bytes_per_view=round(bpv, 1))
+            print("  serve_multihost_wire curve: "
+                  + " ".join("%s:%.3f:%.0f:%.3f" % a for a in arms)
+                  + "  (codec:views_per_sec:bytes_per_view:retry_rate, "
+                  "%d req/arm, %d hosts)" % (n_req, H), file=sys.stderr)
+            json_bpv, int8_bpv = arms[0][2], arms[2][2]
+            assert int8_bpv * 3.0 <= json_bpv, (
+                "serve_multihost_wire: bin_int8+coalescing moved %.0f "
+                "bytes/view vs JSON's %.0f — less than the 3x cut the "
+                "wire fabric promises" % (int8_bpv, json_bpv))
+            return arms[2][1], None, None, 1
+
+        def _bytes_moved(hids):
+            return sum(handles[h].bytes_tx + handles[h].bytes_rx
+                       for h in hids)
+
         def arm(H, drain_one=False):
             ring = HostRing()
             front = RingFront(ring, {})
-            for hid in list(handles)[:H]:
+            hids = list(handles)[:H]
+            for hid in hids:
                 front.add_host(hid, handles[hid])
             if drain_one:
                 # ring-side mark only: the process stays up for later
@@ -1494,22 +1574,26 @@ def _measure_serve_multihost(name, steps=MEASURE_STEPS, keep_run=False):
                 ring.drain("h0", emit=False)
             try:
                 flood(front, max(n_req // 4, n_keys))  # routing warm-up
+                b0 = _bytes_moved(hids)
                 vps = flood(front, n_req)
-                return vps, front.remote_route_fraction()
+                bpv = (_bytes_moved(hids) - b0) / n_req
+                return vps, front.remote_route_fraction(), bpv
             finally:
                 front.close()
 
         curve = [(H,) + arm(H) for H in counts]
-        fo_vps, fo_frac = arm(counts[-1], drain_one=True)
+        fo_vps, fo_frac, fo_bpv = arm(counts[-1], drain_one=True)
 
         print("  serve_multihost curve: "
-              + " ".join("%d:%.3f:%.3f" % (H, vps, frac)
-                         for H, vps, frac in curve)
-              + " failover%d:%.3f:%.3f" % (counts[-1], fo_vps, fo_frac)
-              + "  (hosts:views_per_sec:remote_frac, %d req/arm)" % n_req,
+              + " ".join("%d:%.3f:%.3f:%.0f" % (H, vps, frac, bpv)
+                         for H, vps, frac, bpv in curve)
+              + " failover%d:%.3f:%.3f:%.0f" % (counts[-1], fo_vps,
+                                                fo_frac, fo_bpv)
+              + "  (hosts:views_per_sec:remote_frac:bytes_per_view, "
+              "%d req/arm)" % n_req,
               file=sys.stderr)
         from mine_tpu import telemetry
-        for H, vps, frac in curve:
+        for H, vps, frac, _bpv in curve:
             telemetry.emit("serve.multihost_point", hosts=H,
                            views_per_sec=round(vps, 3),
                            remote_frac=round(frac, 4))

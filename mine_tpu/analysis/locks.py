@@ -96,6 +96,13 @@ LOCK_RANKS: Dict[str, int] = {
     "serve.ring": 8,
     "serve.net.breaker": 9,
     "serve.batcher.cv": 10,
+    # serve.wire.* (PR 20): the front's owner-coalescer queue is acquired
+    # from submit() holding nothing and released before any dispatch; the
+    # client's negotiation flag guards one bool and is released before the
+    # probe round — neither ever nests under or over another serve lock,
+    # so both sit in the unused gap above the breaker.
+    "serve.wire.coalesce": 11,
+    "serve.wire.negotiate": 12,
     "serve.fleet.cache": 15,
     "telemetry.recorder.ring": 18,
     "telemetry.tracing.ctx": 20,
@@ -222,11 +229,14 @@ def ordered_condition(name: str,
 # The flight-recorder dump worker and the resource-gauge sampler joined
 # the list with PR 15: both have explicit close() paths; the ring front's
 # heartbeat prober (serve/ring.py, serve.net.probe_interval_s) joined
-# with PR 19 — RingFront.close() stops and joins it.
+# with PR 19 — RingFront.close() stops and joins it; the front's
+# owner-coalescer flusher (serve.wire.coalesce_ms, PR 20) follows the
+# same close() discipline.
 OWNED_THREAD_NAMES = ("mine-tpu-serve-batcher", "mine-tpu-ops-server",
                       "mine-tpu-flight-recorder",
                       "mine-tpu-resource-sampler",
-                      "mine-tpu-ring-prober")
+                      "mine-tpu-ring-prober",
+                      "mine-tpu-wire-coalescer")
 
 
 def leaked_threads(baseline=None):

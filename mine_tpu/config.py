@@ -413,6 +413,17 @@ class ServeConfig:
     net_suspect_misses: int = 3
     net_dead_misses: int = 10
     net_revive_probes: int = 2
+    # serve.wire.*: the binary wire fabric (serve/wire.py WirePolicy) —
+    # mtpu-wire1 length-prefixed frames with raw little-endian tensors
+    # instead of JSON/base64, an f32|bf16|int8 tensor codec for
+    # image/rgb/depth payloads, and the front's owner-coalescer (N
+    # same-owner requests per linger window leave as ONE batch frame).
+    # ALL default off: wire-off negotiates nothing, frames nothing, and
+    # the transport is bitwise-identical to the JSON path (test-pinned).
+    wire_format: str = "json"
+    wire_codec: str = "f32"
+    wire_coalesce_ms: float = 0.0
+    wire_coalesce_max: int = 8
 
 
 def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
@@ -481,6 +492,10 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         net_suspect_misses=int(g("serve.net.suspect_misses", 3)),
         net_dead_misses=int(g("serve.net.dead_misses", 10)),
         net_revive_probes=int(g("serve.net.revive_probes", 2)),
+        wire_format=str(g("serve.wire.format", "json")),
+        wire_codec=str(g("serve.wire.codec", "f32")),
+        wire_coalesce_ms=float(g("serve.wire.coalesce_ms", 0.0) or 0.0),
+        wire_coalesce_max=int(g("serve.wire.coalesce_max", 8)),
     )
     from mine_tpu.serve.cache import QUANT_MODES
     for key, val in (("serve.cache_quant", out.cache_quant),
@@ -652,6 +667,23 @@ def serve_config_from_dict(config: Dict[str, Any]) -> ServeConfig:
         raise ValueError(
             f"serve.net.revive_probes must be >= 1, "
             f"got {out.net_revive_probes}")
+    from mine_tpu.serve.wire import WIRE_CODECS, WIRE_FORMATS
+    if out.wire_format not in WIRE_FORMATS:
+        raise ValueError(
+            f"serve.wire.format must be one of {'|'.join(WIRE_FORMATS)}, "
+            f"got {out.wire_format!r}")
+    if out.wire_codec not in WIRE_CODECS:
+        raise ValueError(
+            f"serve.wire.codec must be one of {'|'.join(WIRE_CODECS)}, "
+            f"got {out.wire_codec!r}")
+    if out.wire_coalesce_ms < 0:
+        raise ValueError(
+            f"serve.wire.coalesce_ms must be >= 0, "
+            f"got {out.wire_coalesce_ms}")
+    if out.wire_coalesce_max < 1:
+        raise ValueError(
+            f"serve.wire.coalesce_max must be >= 1, "
+            f"got {out.wire_coalesce_max}")
     return out
 
 

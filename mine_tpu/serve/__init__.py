@@ -23,6 +23,9 @@ asymmetry (README "Serving" / "Sharded serving"):
                autoscaler (serve.ring.* keys, default off)
   hostnet.py   HostServer / HostClient — stdlib HTTP/JSON host transport,
                SIGTERM drain, subprocess host entrypoint
+  wire.py      mtpu-wire1 binary frame format + f32/bf16/int8 wire codecs
+               and the shared JSON framing seam (serve.wire.* keys,
+               default off)
 
 Configured by the serve.* keys (configs/params_default.yaml,
 config.ServeConfig).
@@ -42,6 +45,7 @@ from mine_tpu.serve.engine import RenderEngine, pow2_bucket
 from mine_tpu.serve.fleet import ServeFleet, ShardedPlaneCache, shard_for_key
 from mine_tpu.serve.hostnet import (CircuitBreaker, HostClient, HostServer,
                                     NetPolicy)
+from mine_tpu.serve.wire import WireError, WirePolicy
 from mine_tpu.serve.ring import (Autoscaler, BreakerOpen, HostRing,
                                  HostUnavailable, LocalHost, RingFront,
                                  pressure_score)
@@ -61,6 +65,7 @@ __all__ = [
     "RequestShed", "RingFront", "SERVE_BATCH_AXIS", "SERVE_MODEL_AXIS",
     "ServeFleet", "SessionManager", "ShardedPlaneCache", "StreamSession",
     "TIER_BEST_EFFORT", "TIER_CRITICAL", "TIER_STANDARD",
+    "WireError", "WirePolicy",
     "dequantize_planes", "dequantize_weights", "env_fingerprint",
     "image_id_for", "keyframe_id", "make_encode_fn", "make_serve_mesh",
     "pow2_bucket", "pressure_score", "probe_drift", "quantize_planes",

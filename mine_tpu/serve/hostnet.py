@@ -43,6 +43,19 @@ latency, refusal, mid-response reset, truncation, partition — is
 injected through the testing/faults.py net_* seams, never by
 monkeypatching this module.
 
+Since PR 20 the JSON wire has a negotiated BINARY sibling (serve/wire.py,
+`serve.wire.*` keys, default off): a wire-enabled server advertises
+`X-Mtpu-Wire: mtpu-wire1` on every response and accepts
+`application/x-mtpu-wire1` batch frames on /render; a wire-enabled client
+checks the advertisement once (a /healthz round) and speaks binary —
+length-prefixed frames, raw little-endian tensors, f32/bf16/int8 wire
+codecs, N coalesced requests per exchange — only to a peer that
+advertised, falling back to the byte-identical JSON path otherwise
+(counted `serve.wire.fallbacks`). ALL framing, JSON and binary, is built
+and parsed by serve/wire.py helpers, so negotiation lives in exactly one
+seam; a corrupted/truncated binary frame is rejected by the mtpu-wire1
+tripwires and RETRIED like mangled JSON, never crashed on.
+
 `main()` is the deployable unit's entrypoint: boot a host from a PACKED
 AOT artifact (tools/aot_warmstore.py --pack) with zero live compiles and
 serve until drained. Run `python -m mine_tpu.serve.hostnet --help`.
@@ -50,40 +63,31 @@ serve until drained. Run `python -m mine_tpu.serve.hostnet --help`.
 
 from __future__ import annotations
 
-import base64
 import dataclasses
 import http.client
 import json
 import random
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from mine_tpu import telemetry
 from mine_tpu.analysis.locks import ordered_condition, ordered_lock
+from mine_tpu.serve import wire
 from mine_tpu.serve.admission import DeadlineExceeded, RequestShed
 from mine_tpu.serve.ring import (HOST_ALIVE, HOST_DRAINING, BreakerOpen,
                                  HostUnavailable)
+# the JSON tensor wire now lives in serve/wire.py (one framing seam for
+# both formats); re-exported here because tools/tests import them from
+# hostnet, the historical home
+from mine_tpu.serve.wire import pack_array, unpack_array  # noqa: F401
 from mine_tpu.testing import faults
 
 # synthetic-host geometry (--synthetic): matches tools/serve_chaos_soak.py
 # so the soak's keys/images render identically through subprocess hosts
 SYN_S, SYN_HW = 4, 8
-
-
-def pack_array(a: np.ndarray) -> Dict:
-    """numpy -> JSON-safe {shape, dtype, b64}; bytes survive verbatim."""
-    a = np.ascontiguousarray(a)
-    return {"shape": list(a.shape), "dtype": str(a.dtype),
-            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
-
-
-def unpack_array(d: Dict) -> np.ndarray:
-    return np.frombuffer(
-        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
-    ).reshape(d["shape"]).copy()
 
 
 def synthetic_encode_fn(img_hwc):
@@ -223,13 +227,19 @@ class HostServer:
 
     def __init__(self, fleet, host_id: str, port: int = 0,
                  host: str = "127.0.0.1", drain_timeout_s: float = 30.0,
-                 recorder=None):
+                 recorder=None, wire_policy=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.fleet = fleet
         self.host_id = str(host_id)
         self.drain_timeout_s = float(drain_timeout_s)
         self.recorder = recorder
+        # serve.wire.*: with a binary WirePolicy the server ADVERTISES
+        # mtpu-wire1 on every response and accepts binary batch frames on
+        # /render. None (the default) is the exact PR-19 server: no
+        # advertisement header, JSON only — byte-identical, test-pinned.
+        self.wire = wire_policy if (wire_policy is not None
+                                    and wire_policy.binary) else None
         self.draining = False
         self.inflight = 0
         self.requests = 0
@@ -249,6 +259,10 @@ class HostServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if srv.wire is not None:
+                    # the capability advertisement the client's one-time
+                    # negotiation check reads (serve/wire.py)
+                    self.send_header(wire.WIRE_HEADER, wire.WIRE_PROTO)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -276,7 +290,7 @@ class HostServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     n = int(self.headers.get("Content-Length", 0) or 0)
-                    body = json.loads(self.rfile.read(n) or b"{}")
+                    raw_body = self.rfile.read(n)
                     if path == "/render":
                         left = None
                         raw = self.headers.get(DEADLINE_HEADER)
@@ -285,10 +299,26 @@ class HostServer:
                                 left = float(raw)
                             except ValueError:
                                 left = None  # malformed = absent
+                        ctype = (self.headers.get("Content-Type")
+                                 or "").split(";")[0].strip()
+                        if (srv.wire is not None
+                                and ctype == wire.CTYPE_BINARY):
+                            telemetry.counter(
+                                "serve.wire.bytes_rx").inc(len(raw_body))
+                            code, payload, rctype = \
+                                srv._handle_render_wire(
+                                    raw_body, deadline_left_ms=left)
+                            telemetry.counter(
+                                "serve.wire.bytes_tx").inc(len(payload))
+                            self._send(code, payload, rctype)
+                            return
+                        body = json.loads(raw_body or b"{}")
                         code, obj = srv._handle_render(
                             body, deadline_left_ms=left)
                         self._send_json(code, obj)
-                    elif path == "/drain":
+                        return
+                    body = json.loads(raw_body or b"{}")
+                    if path == "/drain":
                         # hand back asynchronously: the response must go
                         # out before the fleet starts tearing down
                         threading.Thread(target=srv.drain,
@@ -313,6 +343,9 @@ class HostServer:
     # -- request path -----------------------------------------------------
 
     def _handle_render(self, body: Dict, deadline_left_ms=None):
+        """The legacy JSON /render: one request, one envelope — behavior
+        (and bytes) identical to PR 19; parsing/packing now rides the
+        serve/wire.py seam shared with the binary path."""
         if deadline_left_ms is not None and deadline_left_ms <= 0:
             # the front's budget was spent in flight: sweep instead of
             # rendering work nobody is waiting on — same verdict (and
@@ -335,16 +368,12 @@ class HostServer:
             deadline_ms = (min(float(deadline_ms), deadline_left_ms)
                            if deadline_ms else deadline_left_ms)
         try:
-            pose = np.asarray(body["pose"],
-                              np.float32).reshape(4, 4)
-            image = body.get("image")
+            req = wire.json_render_request(body)
             rgb, depth = self.fleet.submit(
-                str(body["image_id"]), pose,
-                tier=body.get("tier"),
-                deadline_ms=deadline_ms,
-                image=unpack_array(image) if image else None).result()
-            return 200, {"ok": True, "rgb": pack_array(rgb),
-                         "depth": pack_array(depth)}
+                req["image_id"], req["pose"], tier=req["tier"],
+                deadline_ms=deadline_ms, image=req["image"]).result()
+            return 200, wire.json_render_envelope(
+                {"ok": True, "rgb": rgb, "depth": depth})
         except Exception as e:
             kind = type(e).__name__
             return (_KIND_STATUS.get(kind, 500),
@@ -353,6 +382,85 @@ class HostServer:
             with self._cv:
                 self.inflight -= 1
                 self._cv.notify_all()
+
+    def _render_core(self, reqs: List[Dict], deadline_left_ms=None):
+        """Admission + fleet dispatch for a decoded BATCH, in request
+        order. Every admissible request is submitted before any result is
+        collected, so an N-request frame rides the fleet's existing
+        coalescing (the batcher groups the in-flight set into device
+        batches exactly as it does for concurrent single requests).
+        Returns one envelope per request — numpy rgb/depth when ok, the
+        admission verdict (kind/error) otherwise; a shed or expired item
+        never fails its batchmates."""
+        out: List[Optional[Dict]] = [None] * len(reqs)
+        pending = []
+        for i, req in enumerate(reqs):
+            if deadline_left_ms is not None and deadline_left_ms <= 0:
+                with self._cv:
+                    self.swept += 1
+                telemetry.counter("serve.net.deadline_swept").inc()
+                out[i] = {"ok": False, "kind": "DeadlineExceeded",
+                          "error": "deadline spent before host dispatch"}
+                continue
+            with self._cv:
+                if self.draining:
+                    out[i] = {"ok": False, "kind": "HostUnavailable",
+                              "error": "draining"}
+                    continue
+                self.inflight += 1
+                self.requests += 1
+            deadline_ms = req.get("deadline_ms")
+            if deadline_left_ms is not None:
+                deadline_ms = (min(float(deadline_ms), deadline_left_ms)
+                               if deadline_ms else deadline_left_ms)
+            try:
+                fut = self.fleet.submit(
+                    req["image_id"], req["pose"], tier=req.get("tier"),
+                    deadline_ms=deadline_ms, image=req.get("image"))
+            except Exception as e:
+                with self._cv:
+                    self.inflight -= 1
+                    self._cv.notify_all()
+                out[i] = {"ok": False, "kind": type(e).__name__,
+                          "error": str(e)}
+                continue
+            pending.append((i, fut))
+        for i, fut in pending:
+            try:
+                rgb, depth = fut.result()
+                out[i] = {"ok": True, "rgb": rgb, "depth": depth}
+            except Exception as e:
+                out[i] = {"ok": False, "kind": type(e).__name__,
+                          "error": str(e)}
+            finally:
+                with self._cv:
+                    self.inflight -= 1
+                    self._cv.notify_all()
+        return out
+
+    def _handle_render_wire(self, raw: bytes, deadline_left_ms=None):
+        """One binary /render exchange: decode the mtpu-wire1 batch frame
+        (hostile frames -> a 400 JSON envelope the client treats as
+        non-retryable), dispatch through _render_core, and mirror the
+        request's codec on the multi-result response frame."""
+        t0 = time.monotonic()
+        try:
+            reqs, codec = wire.decode_render_request(raw)
+        except wire.WireError as e:
+            telemetry.counter("serve.wire.rejects").inc()
+            env = {"ok": False, "kind": "WireError", "error": str(e)}
+            return 400, (json.dumps(env) + "\n").encode(), wire.CTYPE_JSON
+        telemetry.histogram("serve.wire.decode_ms").record(
+            (time.monotonic() - t0) * 1e3)
+        envs = self._render_core(reqs, deadline_left_ms=deadline_left_ms)
+        t0 = time.monotonic()
+        payload = wire.encode_render_response(envs, codec=codec)
+        telemetry.histogram("serve.wire.encode_ms").record(
+            (time.monotonic() - t0) * 1e3)
+        # per-item verdicts travel INSIDE the frame envelopes (the client
+        # re-raises typed per item); the HTTP status stays 200 for any
+        # well-formed frame
+        return 200, payload, wire.CTYPE_BINARY
 
     # -- lifecycle --------------------------------------------------------
 
@@ -454,9 +562,12 @@ def install_drain_signals(server: HostServer):
 _STALE = (http.client.BadStatusLine, http.client.CannotSendRequest,
           ConnectionResetError, BrokenPipeError)
 # what a bounded retry may absorb: transport errors, protocol garbage,
-# truncated/mangled JSON — never an application verdict (the error
-# envelope arrives as a 200..5xx with valid JSON and is re-raised typed)
-_RETRYABLE = (OSError, http.client.HTTPException, json.JSONDecodeError)
+# truncated/mangled JSON, and a binary frame that fails the mtpu-wire1
+# tripwires (same class of damage as mangled JSON) — never an application
+# verdict (the error envelope arrives as a 200..5xx with valid JSON and
+# is re-raised typed)
+_RETRYABLE = (OSError, http.client.HTTPException, json.JSONDecodeError,
+              wire.WireError)
 
 
 class HostClient:
@@ -475,12 +586,22 @@ class HostClient:
     DeadlineExceeded CLIENT-side, without a wire attempt). Policy-off
     keeps the legacy single-attempt, single-timeout behavior.
 
+    With a WirePolicy whose format is "binary" (serve.wire.*) the client
+    NEGOTIATES: the first render checks whether the peer ever advertised
+    `X-Mtpu-Wire` (one /healthz round if no response has been seen yet)
+    and speaks mtpu-wire1 batch frames only to a peer that did, falling
+    back to this exact JSON path otherwise — counted
+    `serve.wire.fallbacks`, decided once per client lifetime. Wire-off
+    (the default) constructs none of it and the request path is
+    byte-identical to PR 19 (test-pinned).
+
     `net_src`/`net_name` tag this client's edge in the faults.py
     partition matrix ("src>dst") so tests sever individual links."""
 
     def __init__(self, address: str, timeout_s: float = 60.0,
                  policy: Optional[NetPolicy] = None, net_src: str = "front",
-                 net_name: str = ""):
+                 net_name: str = "",
+                 wire_policy: Optional["wire.WirePolicy"] = None):
         host, port = address.rsplit(":", 1)
         self.host = host
         self.port = int(port)
@@ -498,6 +619,16 @@ class HostClient:
         self._local = threading.local()
         self.reconnects = 0  # stale keep-alive sockets replaced
         self.retries = 0     # policy retry attempts actually taken
+        # payload bytes over this client's link, BOTH formats — the bench
+        # derives bytes/view from deltas, so the JSON arm is measurable
+        self.bytes_tx = 0
+        self.bytes_rx = 0
+        self.wire_policy = wire_policy if (wire_policy is not None
+                                           and wire_policy.binary) else None
+        self._wire_ok: Optional[bool] = None  # None = not yet negotiated
+        self._server_wire = False  # peer advertised X-Mtpu-Wire
+        self._neg_lock = ordered_lock("serve.wire.negotiate") \
+            if self.wire_policy is not None else None
 
     # -- connection management (per thread) -------------------------------
 
@@ -521,19 +652,32 @@ class HostClient:
                 pass
 
     def _wire(self, method: str, path: str, payload, headers):
-        """One HTTP round over this thread's kept-alive connection."""
+        """One HTTP round over this thread's kept-alive connection.
+        Returns (status, content-type, raw bytes) — decoding is the
+        _decode_body seam's job, so the truncation fault can hand a CUT
+        binary frame up to the mtpu-wire1 tripwires (proving the
+        rejection path) while the JSON path keeps raising IncompleteRead
+        exactly as PR 19 pinned."""
         conn = self._conn()
         if conn.sock is None:
             conn.connect()  # under connect_timeout_s
             if self.policy is not None:
                 conn.sock.settimeout(self.policy.read_timeout_s)
         conn.request(method, path, body=payload, headers=headers)
+        self.bytes_tx += len(payload) if payload else 0
         resp = conn.getresponse()
         data = resp.read()
+        self.bytes_rx += len(data)
+        if resp.getheader(wire.WIRE_HEADER) == wire.WIRE_PROTO:
+            self._server_wire = True  # capability capture (benign race)
+        ctype = (resp.getheader("Content-Type") or "").split(";")[0].strip()
         if faults.net_truncate():
             self._drop_conn()
-            raise http.client.IncompleteRead(data[:len(data) // 2])
-        return resp.status, json.loads(data or b"{}")
+            if ctype == wire.CTYPE_BINARY:
+                data = data[:len(data) // 2]  # decoder must reject it
+            else:
+                raise http.client.IncompleteRead(data[:len(data) // 2])
+        return resp.status, ctype, data
 
     def _attempt(self, method: str, path: str, payload, headers):
         """One logical attempt: the fault seam, the wire, and at most one
@@ -562,12 +706,34 @@ class HostClient:
 
     # -- request path -----------------------------------------------------
 
+    @staticmethod
+    def _encode_body(body):
+        """THE request-framing seam (satellite: negotiation in one
+        place): dict bodies frame as the PR-19 JSON bytes; a pre-framed
+        mtpu-wire1 payload (bytes) passes through with the binary
+        Content-Type. Both render paths and every control endpoint
+        funnel through here."""
+        if body is None:
+            return None, wire.CTYPE_JSON
+        if isinstance(body, (bytes, bytearray)):
+            return bytes(body), wire.CTYPE_BINARY
+        return json.dumps(body).encode(), wire.CTYPE_JSON
+
+    @staticmethod
+    def _decode_body(ctype: str, data: bytes):
+        """The response half of the seam: binary frames decode through
+        the mtpu-wire1 tripwires (WireError -> retried), everything else
+        parses as JSON (json.JSONDecodeError -> retried)."""
+        if ctype == wire.CTYPE_BINARY:
+            return wire.decode_render_response(data)
+        return json.loads(data or b"{}")
+
     def _request(self, method: str, path: str,
-                 body: Optional[Dict] = None,
+                 body=None,
                  deadline_ms: Optional[float] = None,
                  retry: bool = True):
-        payload = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
+        payload, ctype = self._encode_body(body)
+        headers = {"Content-Type": ctype}
         pol = self.policy
         attempts = 1 + (pol.retries if (pol is not None and retry) else 0)
         t0 = time.monotonic()
@@ -584,8 +750,9 @@ class HostClient:
             if self.breaker is not None and not self.breaker.allow():
                 raise BreakerOpen(f"{self.address}: circuit open")
             try:
-                status, obj = self._attempt(method, path, payload,
-                                            headers)
+                status, rctype, data = self._attempt(method, path,
+                                                     payload, headers)
+                obj = self._decode_body(rctype, data)
             except _RETRYABLE as e:
                 if self.breaker is not None:
                     self.breaker.record(False)
@@ -606,17 +773,98 @@ class HostClient:
             return status, obj
         raise RuntimeError("unreachable")  # loop always returns/raises
 
+    def _negotiate(self) -> bool:
+        """Once per client lifetime: does the peer speak mtpu-wire1? The
+        advertisement header rides EVERY wire-enabled response, so any
+        prior round already answered; otherwise spend one /healthz. A
+        silent (JSON-only) peer or a dead probe pins the fallback —
+        binary framing AND the front's coalescer stay off for this link,
+        counted `serve.wire.fallbacks`."""
+        with self._neg_lock:
+            if self._wire_ok is not None:
+                return self._wire_ok
+        if not self._server_wire:
+            try:
+                self._request("GET", "/healthz", retry=False)
+            except Exception:
+                pass
+        ok = self._server_wire
+        with self._neg_lock:
+            if self._wire_ok is None:
+                self._wire_ok = ok
+                if not ok:
+                    telemetry.counter("serve.wire.fallbacks").inc()
+        return self._wire_ok
+
+    def wire_active(self) -> bool:
+        """True when this link negotiated binary framing (the RingFront
+        consults this before arming the owner-coalescer for a handle)."""
+        return self.wire_policy is not None and self._negotiate()
+
     def render(self, image_id, pose, tier=None, deadline_ms=None,
                image=None):
-        body = {"image_id": str(image_id),
-                "pose": np.asarray(pose, np.float32).reshape(-1).tolist(),
-                "tier": tier, "deadline_ms": deadline_ms,
-                "image": pack_array(np.asarray(image, np.float32))
-                if image is not None else None}
+        if self.wire_policy is not None and self._negotiate():
+            env = self.render_batch(
+                [{"image_id": image_id, "pose": pose, "tier": tier,
+                  "deadline_ms": deadline_ms, "image": image}],
+                deadline_ms=deadline_ms)[0]
+            if env.get("ok"):
+                return env["rgb"], env["depth"]
+            exc = _KIND_RAISE.get(env.get("kind", ""), RuntimeError)
+            raise exc(f"{self.address}: {env.get('error', '')}")
+        return self._render_json(image_id, pose, tier, deadline_ms, image)
+
+    def _render_json(self, image_id, pose, tier, deadline_ms, image):
+        """The PR-19 wire, byte-identical (framed by wire.py's pinned
+        JSON builders)."""
+        body = wire.json_render_body(
+            {"image_id": image_id, "pose": pose, "tier": tier,
+             "deadline_ms": deadline_ms, "image": image})
         status, obj = self._request("POST", "/render", body,
                                     deadline_ms=deadline_ms)
         if status == 200 and obj.get("ok"):
-            return unpack_array(obj["rgb"]), unpack_array(obj["depth"])
+            env = wire.json_render_result(obj)
+            return env["rgb"], env["depth"]
+        kind = obj.get("kind", "")
+        exc = _KIND_RAISE.get(kind, RuntimeError)
+        raise exc(f"{self.address}: {obj.get('error', f'HTTP {status}')}")
+
+    def render_batch(self, reqs: List[Dict],
+                     deadline_ms: Optional[float] = None) -> List[Dict]:
+        """N render requests, ONE negotiated mtpu-wire1 exchange; returns
+        one envelope per request IN REQUEST ORDER ({"ok": True, "rgb",
+        "depth"} numpy, or {"ok": False, "kind", "error"}). Against a
+        peer that never advertised, degrades to N sequential JSON rounds
+        — same envelopes, PR-19 bytes."""
+        if not (self.wire_policy is not None and self._negotiate()):
+            out = []
+            for r in reqs:
+                try:
+                    rgb, depth = self._render_json(
+                        r["image_id"], r["pose"], r.get("tier"),
+                        r.get("deadline_ms"), r.get("image"))
+                    out.append({"ok": True, "rgb": rgb, "depth": depth})
+                except Exception as e:
+                    out.append({"ok": False, "kind": type(e).__name__,
+                                "error": str(e)})
+            return out
+        t0 = time.monotonic()
+        payload = wire.encode_render_request(
+            reqs, codec=self.wire_policy.codec)
+        telemetry.histogram("serve.wire.encode_ms").record(
+            (time.monotonic() - t0) * 1e3)
+        status, obj = self._request("POST", "/render", payload,
+                                    deadline_ms=deadline_ms)
+        if isinstance(obj, list):
+            if len(obj) != len(reqs):
+                # a valid frame with the wrong arity is a server bug,
+                # not wire damage — surface it, don't retry it
+                raise RuntimeError(
+                    f"{self.address}: batch response carries {len(obj)} "
+                    f"envelope(s) for {len(reqs)} request(s)")
+            return obj
+        # a JSON envelope to a binary frame is a BATCH-level verdict
+        # (hostile-frame 400, draining 503, ...): re-raise typed
         kind = obj.get("kind", "")
         exc = _KIND_RAISE.get(kind, RuntimeError)
         raise exc(f"{self.address}: {obj.get('error', f'HTTP {status}')}")
@@ -626,9 +874,11 @@ class HostClient:
         heartbeat prober IS the half-open admission — its verdict feeds
         the breaker either way, so an open circuit heals from probes
         without spending a caller's request on it."""
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": wire.CTYPE_JSON}
         try:
-            _, obj = self._attempt("GET", "/healthz", None, headers)
+            _, rctype, data = self._attempt("GET", "/healthz", None,
+                                            headers)
+            obj = self._decode_body(rctype, data)
         except Exception:
             if self.breaker is not None:
                 self.breaker.record(False)
@@ -713,6 +963,10 @@ def main(argv=None) -> int:
     ap.add_argument("--warm-seed", type=int, default=0,
                     help="synthetic image seed for --warm-key")
     ap.add_argument("--drain-timeout-s", type=float, default=30.0)
+    ap.add_argument("--wire", choices=list(wire.WIRE_FORMATS),
+                    default="json",
+                    help="binary advertises mtpu-wire1 + accepts batch "
+                         "frames on /render (serve.wire.format)")
     ap.add_argument("--incidents-dir", type=str, default="",
                     help="arm a flight recorder; drains dump a bundle")
     ap.add_argument("--build-artifact", type=str, default="",
@@ -776,9 +1030,11 @@ def main(argv=None) -> int:
         loads = fleet.engine.bucket_loads
         compiles = fleet.engine.bucket_compiles
 
+    wire_policy = (wire.WirePolicy(format="binary")
+                   if args.wire == "binary" else None)
     server = HostServer(fleet, args.host_id, port=args.port,
                         drain_timeout_s=args.drain_timeout_s,
-                        recorder=recorder).start()
+                        recorder=recorder, wire_policy=wire_policy).start()
     handler = install_drain_signals(server)
     telemetry.emit("serve.host_join", host=args.host_id, hosts=1,
                    aot_loads=loads, aot_compiles=compiles)

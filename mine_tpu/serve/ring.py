@@ -47,9 +47,10 @@ import time
 from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
 
 from mine_tpu import telemetry
-from mine_tpu.analysis.locks import ordered_lock
-from mine_tpu.serve.admission import DeadlineExceeded
+from mine_tpu.analysis.locks import ordered_condition, ordered_lock
+from mine_tpu.serve.admission import DeadlineExceeded, RequestShed
 from mine_tpu.serve.fleet import shard_for_key
+from mine_tpu.telemetry import tracing
 
 _METRIC_PREFIX = "serve.ring"
 
@@ -298,6 +299,35 @@ class LocalHost:
                                  deadline_ms=deadline_ms,
                                  image=image).result()
 
+    def render_batch(self, reqs: List[Dict],
+                     deadline_ms=None) -> List[Dict]:
+        """The handle batch protocol, locally: submit EVERY request to
+        the fleet before collecting any result — a coalesced group rides
+        the batcher's existing dispatch coalescing — and return one
+        envelope per request in request order (the HostClient.render_batch
+        shape, so the front's coalescer is handle-agnostic)."""
+        if self.draining:
+            raise HostUnavailable("host draining")
+        pending = []
+        for r in reqs:
+            try:
+                pending.append(self.fleet.submit(
+                    r["image_id"], r["pose"], tier=r.get("tier"),
+                    deadline_ms=r.get("deadline_ms"), image=r.get("image")))
+            except Exception as e:
+                pending.append(e)
+        envs: List[Dict] = []
+        for p in pending:
+            try:
+                if isinstance(p, Exception):
+                    raise p
+                rgb, depth = p.result()
+                envs.append({"ok": True, "rgb": rgb, "depth": depth})
+            except Exception as e:
+                envs.append({"ok": False, "kind": type(e).__name__,
+                             "error": str(e)})
+        return envs
+
     def healthz(self) -> Dict:
         out = dict(self.fleet.health())
         out["state"] = HOST_DRAINING if self.draining else HOST_ALIVE
@@ -337,7 +367,7 @@ class RingFront:
     """
 
     def __init__(self, ring: HostRing, handles: Dict[str, object],
-                 workers: int = 8, policy=None) -> None:
+                 workers: int = 8, policy=None, wire=None) -> None:
         self.ring = ring
         self.handles = dict(handles)
         self.owner_routes = 0
@@ -370,6 +400,27 @@ class RingFront:
                 target=self._probe_loop, name="mine-tpu-ring-prober",
                 daemon=True)
             self._prober.start()
+        # --- owner-coalescer (serve.wire.*; None/off = legacy path) ------
+        # armed ONLY for binary wire + a positive linger window: same-owner
+        # requests enqueued inside `coalesce_ms` leave as ONE render_batch
+        # frame (full bucket of `coalesce_max` flushes immediately — the
+        # local ContinuousBatcher's full-bucket-or-deadline discipline,
+        # one level up). Off constructs nothing: submit() is PR-19 verbatim.
+        self.wire = wire if (wire is not None
+                             and getattr(wire, "binary", False)
+                             and getattr(wire, "coalesce", False)) else None
+        self.coalesced = 0       # requests that left inside a batch frame
+        self.coalesce_flushes = 0
+        self._co_groups: Dict[str, List[Dict]] = {}   # host -> queued items
+        self._co_due: Dict[str, float] = {}           # host -> flush time
+        self._co_stop = threading.Event()
+        self._co_cv = ordered_condition("serve.wire.coalesce")
+        self._co_thread: Optional[threading.Thread] = None
+        if self.wire is not None:
+            self._co_thread = threading.Thread(
+                target=self._co_loop, name="mine-tpu-wire-coalescer",
+                daemon=True)
+            self._co_thread.start()
 
     def add_host(self, host: str, handle, aot_loads: int = 0,
                  aot_compiles: int = 0) -> None:
@@ -384,8 +435,152 @@ class RingFront:
     def submit(self, image_id: str, pose, tier=None, deadline_ms=None,
                image=None) -> "concurrent.futures.Future":
         t0 = self._now()  # deadline budget starts at ENQUEUE, not dispatch
+        if self.wire is not None:
+            fut = self._co_enqueue(image_id, pose, tier, deadline_ms,
+                                   image, t0)
+            if fut is not None:
+                return fut
         return self._pool.submit(self._route_one, image_id, pose, tier,
                                  deadline_ms, image, t0)
+
+    # -- owner-coalescer (serve.wire.*) -----------------------------------
+
+    def _co_enqueue(self, image_id, pose, tier, deadline_ms, image, t0):
+        """Queue a request into its owner's linger group. Returns the
+        future, or None when this request cannot ride a batch frame —
+        owner unresolvable, handle without the batch protocol, or a peer
+        that negotiated down to JSON — in which case submit() falls back
+        to the per-request route (correctness never depends on
+        coalescing)."""
+        try:
+            with self._lock:
+                avoid: FrozenSet[str] = frozenset(self._suspects)
+            host = self.ring.owner(image_id, avoid=avoid)
+        except HostUnavailable:
+            return None
+        with self._lock:
+            handle = self.handles.get(host)
+        if handle is None or not hasattr(handle, "render_batch"):
+            return None
+        active = getattr(handle, "wire_active", None)
+        if active is not None and not active():
+            return None  # negotiation fell back: no frames on this link
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        item = {"future": fut, "image_id": image_id, "pose": pose,
+                "tier": tier, "deadline_ms": deadline_ms, "image": image,
+                "t0": t0}
+        flush = None
+        with self._co_cv:
+            group = self._co_groups.setdefault(host, [])
+            if not group:
+                self._co_due[host] = t0 + self.wire.coalesce_ms / 1e3
+            group.append(item)
+            if len(group) >= int(self.wire.coalesce_max):
+                # full bucket flushes NOW; the linger window is a
+                # deadline, not a dwell
+                flush = self._co_groups.pop(host)
+                self._co_due.pop(host, None)
+            else:
+                self._co_cv.notify_all()
+        if flush is not None:
+            self._pool.submit(self._flush_group, host, flush)
+        return fut
+
+    def _co_loop(self) -> None:
+        """Deadline flusher: wake at the earliest group's linger expiry
+        and hand expired groups to the pool (dispatch never runs under
+        the coalesce lock)."""
+        while not self._co_stop.is_set():
+            batches = []
+            with self._co_cv:
+                now = self._now()
+                due = [h for h, t in self._co_due.items() if t <= now]
+                for h in due:
+                    batches.append((h, self._co_groups.pop(h)))
+                    self._co_due.pop(h, None)
+                if not batches:
+                    waits = [t - now for t in self._co_due.values()]
+                    self._co_cv.wait(
+                        max(0.001, min(waits)) if waits else 0.05)
+            for host, group in batches:
+                self._pool.submit(self._flush_group, host, group)
+
+    def _flush_group(self, host: str, group: List[Dict]) -> None:
+        """One coalesced exchange: N queued same-owner requests as one
+        render_batch call, envelopes mapped back to futures IN REQUEST
+        ORDER. Any transport-shaped failure (batch-level exception, arity
+        mismatch, per-item HostUnavailable) demotes the affected items to
+        the ordinary per-request failover walk with their ORIGINAL
+        enqueue time — coalescing can cost latency, never answers."""
+        n = len(group)
+        with self._lock:
+            self.coalesce_flushes += 1
+            self.coalesced += n
+            handle = self.handles.get(host)
+        telemetry.histogram("serve.wire.coalesce_size").record(n)
+        ctx = tracing.start("serve.wire.exchange", codec=self.wire.codec,
+                            host=host, n=n)
+        # the exchange's client-side budget: the tightest remaining
+        # per-item budget (None when none carries a deadline)
+        now = self._now()
+        lefts = [float(it["deadline_ms"]) - (now - it["t0"]) * 1e3
+                 for it in group if it["deadline_ms"]]
+        batch_deadline = min(lefts) if lefts else None
+        envs = None
+        if handle is not None:
+            reqs = [{"image_id": it["image_id"], "pose": it["pose"],
+                     "tier": it["tier"], "deadline_ms": it["deadline_ms"],
+                     "image": it["image"]} for it in group]
+            try:
+                envs = handle.render_batch(reqs,
+                                           deadline_ms=batch_deadline)
+            except DeadlineExceeded:
+                envs = None  # the walk re-raises per item, counted
+            except HostUnavailable:
+                self.ring.drain(host, emit=False)
+                self._count_reroute()
+            except BreakerOpen:
+                self._suspect_host(host)
+                self._count_reroute()
+            except (TimeoutError, socket.timeout):
+                self._suspect_host(host)
+                self._count_reroute()
+            except (ConnectionError, OSError):
+                self.ring.mark_dead(host)
+                self._count_reroute()
+            except Exception:
+                pass  # unknown damage: the per-item walk decides
+        if envs is None or len(envs) != n:
+            tracing.finish(ctx, ok=False)
+            for it in group:
+                self._route_item_fallback(it)
+            return
+        tracing.finish(ctx, ok=True)
+        for it, env in zip(group, envs):
+            if env.get("ok"):
+                slot = self.ring.slot_owner(it["image_id"])
+                self._count_route(host, host == slot)
+                it["future"].set_result((env["rgb"], env["depth"]))
+            elif env.get("kind") == "HostUnavailable":
+                # draining mid-batch: same routing fact as the single
+                # path — mark and let the item walk ring-wise
+                self.ring.drain(host, emit=False)
+                self._count_reroute()
+                self._route_item_fallback(it)
+            else:
+                exc = {"RequestShed": RequestShed,
+                       "DeadlineExceeded": DeadlineExceeded}.get(
+                           env.get("kind", ""), RuntimeError)
+                it["future"].set_exception(exc(env.get("error", "")))
+
+    def _route_item_fallback(self, it: Dict) -> None:
+        try:
+            out = self._route_one(it["image_id"], it["pose"], it["tier"],
+                                  it["deadline_ms"], it["image"],
+                                  it["t0"])
+            it["future"].set_result(out)
+        except Exception as e:
+            it["future"].set_exception(e)
 
     def render(self, image_id: str, pose, tier=None, deadline_ms=None,
                image=None):
@@ -625,6 +820,10 @@ class RingFront:
                 "failures": self.failures,
                 "per_host": {h: list(v) for h, v in self._per_host.items()},
             }
+            if self.wire is not None:
+                out["wire"] = {"codec": self.wire.codec,
+                               "coalesced": self.coalesced,
+                               "coalesce_flushes": self.coalesce_flushes}
         out["ring"] = self.ring.stats()
         if self.policy is not None:
             out["net"] = self.net_stats()
@@ -645,6 +844,20 @@ class RingFront:
             self._probe_stop.set()
             self._prober.join(timeout=10.0)
             self._prober = None
+        if self._co_thread is not None:
+            self._co_stop.set()
+            with self._co_cv:
+                self._co_cv.notify_all()
+            self._co_thread.join(timeout=10.0)
+            self._co_thread = None
+            # drain any still-lingering groups so no caller's future is
+            # abandoned by teardown
+            with self._co_cv:
+                leftovers = list(self._co_groups.items())
+                self._co_groups.clear()
+                self._co_due.clear()
+            for host, group in leftovers:
+                self._flush_group(host, group)
         # the front's final route ledger, attached to one last rebalance
         # record so postmortems see the split without scraping counters
         alive = len(self.ring.alive())

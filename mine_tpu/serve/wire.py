@@ -1,0 +1,512 @@
+"""`mtpu-wire1`: the host ring's binary wire format + tensor codecs.
+
+PRs 18-19 made the ring elastic and failure-hardened, but every byte still
+crossed the wire as JSON with base64 float32 tensors (~4/3 inflation on a
+~4.7 MB flagship source image) and every request paid its own HTTP round
+trip. This module is the transport's answer: a length-prefixed binary frame
+(no base64, raw little-endian tensor bytes) plus wire codecs that ship the
+*cheapest sufficient representation* — the int8 per-channel scheme the
+plane cache already trusts (serve/cache.py) applied to the hop itself.
+
+Frame layout (all integers little-endian):
+
+    +----------------+-------------+------------------+------------------+
+    | magic (10 B)   | hlen (u32)  | header JSON      | tensor segments  |
+    | b"mtpu-wire1"  |             | (hlen bytes)     | (concatenated)   |
+    +----------------+-------------+------------------+------------------+
+
+The header is compact JSON: {"v": 1, "body": <JSON-safe dict>,
+"tensors": [<desc>, ...]} where each desc declares its codec and the raw
+segments ({"dtype", "shape", "nbytes"}) that follow in order. The body
+references tensors by index (the request/response helpers below use plain
+ints), so the JSON stays tiny while the tensors travel as verbatim bytes.
+
+Decoding is HOSTILE-FRAME SAFE — a frame is rejected (`WireError`, which
+the hardened client treats as retryable transport garbage, never crashed
+on) when any of the four tripwires fires:
+
+    bad magic        the prefix is not b"mtpu-wire1"
+    truncated        declared header/segment bytes exceed what arrived
+    oversized        the frame or any declared size exceeds `max_bytes`
+    segment mismatch trailing bytes after the declared segments, or a
+                     tensor desc whose segment count disagrees
+
+Wire codecs (applied to float32 payload tensors only; every other dtype —
+and every tensor under codec "f32" — ships raw and round-trips BITWISE):
+
+    f32    raw little-endian float32 bytes (bitwise; the default)
+    bf16   round-to-nearest-even narrowing to bfloat16 on the wire,
+           widen-cast back to float32 on receipt (2x smaller; every bf16
+           is exactly representable in f32, so the widening is lossless)
+    int8   per-channel symmetric quantization — scale = max|x|/127 over
+           the trailing two axes, the EXACT serve/cache.py scheme — 4x
+           smaller with |x - dequant(x)| <= scale/2 per group
+
+Negotiation rides Content-Type: a wire-enabled server advertises
+`X-Mtpu-Wire: mtpu-wire1` on every response; a wire-enabled client checks
+once (a /healthz round) and speaks `application/x-mtpu-wire1` only to a
+server that advertised — anything else falls back to the byte-identical
+PR-19 JSON path (counted `serve.wire.fallbacks`). The JSON body/envelope
+builders live here too, so framing knowledge — JSON and binary — sits in
+exactly ONE seam shared by HostClient and HostServer (serve/hostnet.py).
+
+Stdlib + numpy only; importing this module never touches jax.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+try:  # bf16 lives in ml_dtypes (a jax dependency); gate it anyway
+    from ml_dtypes import bfloat16 as _BF16
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+MAGIC = b"mtpu-wire1"
+VERSION = 1
+# refuse to decode (or declare) frames beyond this many bytes — a hostile
+# length prefix must never become an allocation
+MAX_FRAME_BYTES = 1 << 28  # 256 MiB
+
+CTYPE_JSON = "application/json"
+CTYPE_BINARY = "application/x-mtpu-wire1"
+# capability advertisement: a wire-enabled HostServer sets this header on
+# EVERY response; its absence is how a binary client detects a JSON-only
+# peer and falls back
+WIRE_HEADER = "X-Mtpu-Wire"
+WIRE_PROTO = "mtpu-wire1"
+
+WIRE_FORMATS = ("json", "binary")
+WIRE_CODECS = ("f32", "bf16", "int8")
+
+_U32 = struct.Struct("<I")
+
+
+class WireError(ValueError):
+    """A frame failed the mtpu-wire1 contract (hostile/corrupt/truncated).
+
+    Deliberately transport-shaped, not application-shaped: the hardened
+    HostClient retries it exactly like mangled JSON — a truncated binary
+    frame is re-requested, never crashed on."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePolicy:
+    """The serve.wire.* knobs as one immutable value (config.py parses the
+    keys; serve_cli builds this and hands it to HostServer, HostClient and
+    the RingFront). The default — format "json", coalesce_ms 0 — arms
+    NOTHING: no negotiation, no frames, no coalescer; the transport stays
+    bitwise-identical to PR 19 (test-pinned)."""
+
+    format: str = "json"      # json | binary (binary arms negotiation)
+    codec: str = "f32"        # f32 | bf16 | int8 tensor codec on the wire
+    coalesce_ms: float = 0.0  # front linger window for same-owner batching
+    coalesce_max: int = 8     # requests per coalesced batch frame (cap)
+
+    @property
+    def binary(self) -> bool:
+        return self.format == "binary"
+
+    @property
+    def coalesce(self) -> bool:
+        return self.coalesce_ms > 0
+
+
+# ------------------------------------------------------------- JSON path
+# The PR-19 wire, verbatim — kept as the negotiated fallback and the
+# default. These builders are the SINGLE source of the JSON byte layout:
+# both hostnet halves call them, so wire-off stays byte-identical by
+# construction (tests/test_serve_wire.py pins the exact payload bytes).
+
+def pack_array(a: np.ndarray) -> Dict:
+    """numpy -> JSON-safe {shape, dtype, b64}; bytes survive verbatim."""
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def unpack_array(d: Dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+def json_render_body(req: Dict) -> Dict:
+    """One render request (numpy pose/image) -> the exact PR-19 JSON body
+    (key insertion order pinned: json.dumps of this dict must reproduce
+    the legacy payload byte-for-byte)."""
+    image = req.get("image")
+    return {"image_id": str(req["image_id"]),
+            "pose": np.asarray(req["pose"],
+                               np.float32).reshape(-1).tolist(),
+            "tier": req.get("tier"),
+            "deadline_ms": req.get("deadline_ms"),
+            "image": pack_array(np.asarray(image, np.float32))
+            if image is not None else None}
+
+
+def json_render_request(body: Dict) -> Dict:
+    """The server half: a decoded JSON /render body -> one request dict
+    with numpy pose/image (the shape HostServer hands the fleet)."""
+    image = body.get("image")
+    return {"image_id": str(body["image_id"]),
+            "pose": np.asarray(body["pose"], np.float32).reshape(4, 4),
+            "tier": body.get("tier"),
+            "deadline_ms": body.get("deadline_ms"),
+            "image": unpack_array(image) if image else None}
+
+
+def json_render_envelope(env: Dict) -> Dict:
+    """One result envelope (numpy rgb/depth when ok) -> the exact PR-19
+    JSON response object."""
+    if env.get("ok"):
+        return {"ok": True, "rgb": pack_array(env["rgb"]),
+                "depth": pack_array(env["depth"])}
+    return {"ok": False, "kind": env.get("kind", ""),
+            "error": env.get("error", "")}
+
+
+def json_render_result(obj: Dict) -> Dict:
+    """One PR-19 JSON response object -> result envelope with numpy
+    rgb/depth (the client half of json_render_envelope)."""
+    if obj.get("ok"):
+        return {"ok": True, "rgb": unpack_array(obj["rgb"]),
+                "depth": unpack_array(obj["depth"])}
+    return {"ok": False, "kind": obj.get("kind", ""),
+            "error": obj.get("error", "")}
+
+
+# ---------------------------------------------------------- tensor codecs
+
+def _c(a: np.ndarray) -> np.ndarray:
+    """C-contiguous view/copy that PRESERVES shape (np.ascontiguousarray
+    silently promotes 0-d to 1-d, which would break the bitwise
+    round-trip contract for scalars)."""
+    a = np.asarray(a)
+    return a if a.flags.c_contiguous else np.ascontiguousarray(a)
+
+
+def _dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        if name == "bfloat16" and _BF16 is not None:
+            return np.dtype(_BF16)
+        raise WireError(f"unknown wire dtype {name!r}")
+
+
+def int8_quantize(a: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel symmetric int8, the serve/cache.py scheme in numpy:
+    scale = max|x|/127 reduced over the TRAILING TWO axes (global for
+    0/1-d), q = clip(round(x/scale), -127, 127). Returns (q, scales) with
+    scales broadcastable against q; |x - q*scale| <= scale/2 per group."""
+    a = _c(np.asarray(a, dtype=np.float32))
+    axes = tuple(range(a.ndim - 2, a.ndim)) if a.ndim >= 2 \
+        else tuple(range(a.ndim))
+    if a.size == 0:
+        shape = [1 if i in axes else d for i, d in enumerate(a.shape)]
+        return a.astype(np.int8), np.ones(shape, np.float32)
+    with np.errstate(invalid="ignore"):
+        amax = np.max(np.abs(a), axis=axes or None, keepdims=bool(axes))
+        # a non-finite group (rendered depth can carry inf/NaN at
+        # zero-alpha pixels) must never poison its scale: clamp to a
+        # finite scale so FINITE members still hold the scale/2 bound and
+        # the wire ships no inf scales (0 * inf = NaN on dequant)
+        amax = np.where(np.isfinite(amax), amax, np.float32(127.0))
+        scales = (np.maximum(amax, 1e-30) / 127.0).astype(np.float32)
+        q = np.clip(np.round(a / scales), -127, 127)
+    q = np.where(np.isfinite(q), q, np.float32(0.0)).astype(np.int8)
+    return q, np.asarray(scales, np.float32)
+
+
+def int8_dequantize(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    with np.errstate(invalid="ignore"):
+        return (q.astype(np.float32)
+                * np.asarray(scales, np.float32)).astype(np.float32)
+
+
+def encode_tensor(a: np.ndarray, codec: str) -> Tuple[Dict, List]:
+    """One tensor -> (desc, raw segment arrays). float32 inputs are
+    transformed per `codec`; every other dtype ships raw (bitwise) — the
+    frame layer is a faithful container for ANY numpy dtype."""
+    if codec not in WIRE_CODECS:
+        raise WireError(f"unknown wire codec {codec!r}")
+    a = _c(a)
+    if a.dtype != np.float32 or codec == "f32":
+        return {"codec": "raw"}, [a]
+    if codec == "bf16":
+        if _BF16 is None:  # pragma: no cover - ml_dtypes ships with jax
+            raise WireError("bf16 wire codec needs ml_dtypes")
+        return {"codec": "bf16"}, [a.astype(_BF16)]
+    q, scales = int8_quantize(a)
+    return {"codec": "int8"}, [q, scales]
+
+
+def decode_tensor(desc: Dict, arrays: Sequence[np.ndarray]) -> np.ndarray:
+    codec = desc.get("codec")
+    if codec == "raw":
+        _want_segs(desc, arrays, 1)
+        return arrays[0]
+    if codec == "bf16":
+        _want_segs(desc, arrays, 1)
+        return arrays[0].astype(np.float32)
+    if codec == "int8":
+        _want_segs(desc, arrays, 2)
+        return int8_dequantize(arrays[0], arrays[1])
+    raise WireError(f"unknown tensor codec {codec!r}")
+
+
+def _want_segs(desc: Dict, arrays: Sequence, n: int) -> None:
+    if len(arrays) != n:
+        raise WireError(
+            f"segment count mismatch: codec {desc.get('codec')!r} "
+            f"declares {len(arrays)} segment(s), needs {n}")
+
+
+# ------------------------------------------------------------ frame layer
+
+def encode_frame(body: Dict, tensors: Sequence[np.ndarray] = (),
+                 codec: str = "f32",
+                 max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """JSON-safe `body` + tensors -> one mtpu-wire1 frame. The body refers
+    to tensors by list index (caller's convention); each tensor is
+    codec-encoded into raw little-endian segments."""
+    descs, segs = [], []
+    for a in tensors:
+        desc, arrs = encode_tensor(a, codec)
+        d_segs = []
+        for arr in arrs:
+            arr = _c(arr)
+            if arr.dtype.byteorder == ">":  # wire bytes are little-endian
+                arr = arr.astype(arr.dtype.newbyteorder("<"))
+            raw = arr.tobytes()
+            d_segs.append({"dtype": str(arr.dtype),
+                           "shape": list(arr.shape), "nbytes": len(raw)})
+            segs.append(raw)
+        descs.append({**desc, "segs": d_segs})
+    header = json.dumps({"v": VERSION, "body": body, "tensors": descs},
+                        separators=(",", ":")).encode()
+    frame = b"".join([MAGIC, _U32.pack(len(header)), header] + segs)
+    if len(frame) > max_bytes:
+        raise WireError(
+            f"oversized frame: {len(frame)} bytes > max {max_bytes}")
+    return frame
+
+
+def decode_frame(data: bytes, max_bytes: int = MAX_FRAME_BYTES
+                 ) -> Tuple[Dict, List[np.ndarray]]:
+    """One frame -> (body, decoded tensors). Every hostile-frame tripwire
+    (module docstring) raises WireError; a valid frame's tensors come back
+    as float32 (codec'd) or their original dtype (raw, bitwise)."""
+    if len(data) > max_bytes:
+        raise WireError(
+            f"oversized frame: {len(data)} bytes > max {max_bytes}")
+    if len(data) < len(MAGIC) + _U32.size:
+        raise WireError(
+            f"truncated frame: {len(data)} bytes is shorter than the "
+            f"magic + length prefix")
+    if data[:len(MAGIC)] != MAGIC:
+        raise WireError(f"bad magic {data[:len(MAGIC)]!r} "
+                        f"(expected {MAGIC!r})")
+    off = len(MAGIC)
+    (hlen,) = _U32.unpack_from(data, off)
+    off += _U32.size
+    if hlen > max_bytes:
+        raise WireError(f"oversized header: {hlen} bytes > max {max_bytes}")
+    if off + hlen > len(data):
+        raise WireError(
+            f"truncated frame: header declares {hlen} bytes, "
+            f"{len(data) - off} remain")
+    try:
+        header = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise WireError(f"bad frame header: {e}") from e
+    off += hlen
+    if not isinstance(header, dict) or header.get("v") != VERSION:
+        raise WireError(
+            f"bad frame header: unknown version "
+            f"{header.get('v') if isinstance(header, dict) else header!r}")
+    descs = header.get("tensors", [])
+    if not isinstance(descs, list):
+        raise WireError("bad frame header: tensors must be a list")
+    tensors: List[np.ndarray] = []
+    for desc in descs:
+        arrs = []
+        d_segs = desc.get("segs", [])
+        if not isinstance(d_segs, list):
+            raise WireError("bad frame header: segs must be a list")
+        for seg in d_segs:
+            dt = _dtype(seg.get("dtype", ""))
+            shape = tuple(int(s) for s in seg.get("shape", []))
+            nbytes = int(seg.get("nbytes", -1))
+            want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            if nbytes != want or nbytes < 0:
+                raise WireError(
+                    f"segment count mismatch: segment declares {nbytes} "
+                    f"bytes but shape {shape} x {dt} needs {want}")
+            if nbytes > max_bytes:
+                raise WireError(
+                    f"oversized segment: {nbytes} bytes > max {max_bytes}")
+            if off + nbytes > len(data):
+                raise WireError(
+                    f"truncated frame: segment needs {nbytes} bytes, "
+                    f"{len(data) - off} remain")
+            arrs.append(np.frombuffer(
+                data, dtype=dt, count=int(np.prod(shape, dtype=np.int64)),
+                offset=off).reshape(shape).copy())
+            off += nbytes
+        tensors.append(decode_tensor(desc, arrs))
+    if off != len(data):
+        raise WireError(
+            f"segment count mismatch: {len(data) - off} trailing bytes "
+            f"after the declared segments")
+    body = header.get("body")
+    if not isinstance(body, dict):
+        raise WireError("bad frame header: body must be an object")
+    return body, tensors
+
+
+# ------------------------------------------- render request/response seam
+# The binary /render exchange is ALWAYS batch-framed (a single render is a
+# batch of one): N same-owner requests cost one HTTP round, and the
+# response carries per-request envelopes IN REQUEST ORDER — the front's
+# coalescer maps result i back to future i no matter how the host-side
+# batcher reordered the work by tier.
+
+def encode_render_request(reqs: Sequence[Dict], codec: str = "f32",
+                          max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """Render requests (numpy pose/image) -> one binary batch frame. The
+    pose always ships raw f32 (16 floats — bitwise matters, size doesn't);
+    the image upload uses `codec`. The frame body carries the codec so the
+    server mirrors it on the response."""
+    tensors: List[np.ndarray] = []
+    items = []
+    for req in reqs:
+        pose = _c(np.asarray(req["pose"], np.float32).reshape(4, 4))
+        item = {"image_id": str(req["image_id"]),
+                "tier": req.get("tier"),
+                "deadline_ms": req.get("deadline_ms"),
+                "pose": len(tensors), "image": None}
+        tensors.append(pose)
+        image = req.get("image")
+        if image is not None:
+            item["image"] = len(tensors)
+            tensors.append(np.asarray(image, np.float32))
+        items.append(item)
+    body = {"kind": "render_batch", "codec": codec, "batch": items}
+    # pose must survive bitwise under EVERY codec: encode_tensor only
+    # transforms f32 tensors, so ship poses raw by encoding per-tensor
+    out_codecs = ["f32"] * len(tensors)
+    for item in items:
+        if item["image"] is not None:
+            out_codecs[item["image"]] = codec
+    return _encode_mixed(body, tensors, out_codecs, max_bytes)
+
+
+def _encode_mixed(body: Dict, tensors: Sequence[np.ndarray],
+                  codecs: Sequence[str], max_bytes: int) -> bytes:
+    """encode_frame with a PER-TENSOR codec choice (poses raw, images
+    quantized)."""
+    descs, segs = [], []
+    for a, codec in zip(tensors, codecs):
+        desc, arrs = encode_tensor(a, codec)
+        d_segs = []
+        for arr in arrs:
+            arr = _c(arr)
+            if arr.dtype.byteorder == ">":
+                arr = arr.astype(arr.dtype.newbyteorder("<"))
+            raw = arr.tobytes()
+            d_segs.append({"dtype": str(arr.dtype),
+                           "shape": list(arr.shape), "nbytes": len(raw)})
+            segs.append(raw)
+        descs.append({**desc, "segs": d_segs})
+    header = json.dumps({"v": VERSION, "body": body, "tensors": descs},
+                        separators=(",", ":")).encode()
+    frame = b"".join([MAGIC, _U32.pack(len(header)), header] + segs)
+    if len(frame) > max_bytes:
+        raise WireError(
+            f"oversized frame: {len(frame)} bytes > max {max_bytes}")
+    return frame
+
+
+def decode_render_request(data: bytes,
+                          max_bytes: int = MAX_FRAME_BYTES
+                          ) -> Tuple[List[Dict], str]:
+    """One binary batch frame -> (request dicts with numpy pose/image,
+    the codec the response should mirror)."""
+    body, tensors = decode_frame(data, max_bytes=max_bytes)
+    if body.get("kind") != "render_batch":
+        raise WireError(f"unexpected frame kind {body.get('kind')!r}")
+    codec = body.get("codec", "f32")
+    if codec not in WIRE_CODECS:
+        raise WireError(f"unknown wire codec {codec!r}")
+    reqs = []
+    for item in body.get("batch", []):
+        reqs.append({
+            "image_id": str(item["image_id"]),
+            "pose": np.asarray(_take(tensors, item["pose"]),
+                               np.float32).reshape(4, 4),
+            "tier": item.get("tier"),
+            "deadline_ms": item.get("deadline_ms"),
+            "image": (_take(tensors, item["image"])
+                      if item.get("image") is not None else None),
+        })
+    return reqs, codec
+
+
+def encode_render_response(envs: Sequence[Dict], codec: str = "f32",
+                           max_bytes: int = MAX_FRAME_BYTES) -> bytes:
+    """Result envelopes ({"ok": True, "rgb", "depth"} with numpy arrays,
+    or {"ok": False, "kind", "error"}) -> one binary batch frame, in
+    REQUEST order. rgb/depth downloads use `codec`."""
+    tensors: List[np.ndarray] = []
+    items = []
+    for env in envs:
+        if env.get("ok"):
+            item = {"ok": True, "rgb": len(tensors),
+                    "depth": len(tensors) + 1}
+            tensors.append(np.asarray(env["rgb"], np.float32))
+            tensors.append(np.asarray(env["depth"], np.float32))
+        else:
+            item = {"ok": False, "kind": env.get("kind", ""),
+                    "error": env.get("error", "")}
+        items.append(item)
+    body = {"kind": "render_batch", "codec": codec, "batch": items}
+    return encode_frame(body, tensors, codec=codec, max_bytes=max_bytes)
+
+
+def decode_render_response(data: bytes,
+                           max_bytes: int = MAX_FRAME_BYTES
+                           ) -> List[Dict]:
+    """One binary batch frame -> result envelopes with numpy rgb/depth."""
+    body, tensors = decode_frame(data, max_bytes=max_bytes)
+    if body.get("kind") != "render_batch":
+        raise WireError(f"unexpected frame kind {body.get('kind')!r}")
+    envs = []
+    for item in body.get("batch", []):
+        if item.get("ok"):
+            envs.append({"ok": True,
+                         "rgb": _take(tensors, item["rgb"]),
+                         "depth": _take(tensors, item["depth"])})
+        else:
+            envs.append({"ok": False, "kind": item.get("kind", ""),
+                         "error": item.get("error", "")})
+    return envs
+
+
+def _take(tensors: List[np.ndarray], idx) -> np.ndarray:
+    try:
+        i = int(idx)
+        if i < 0:
+            raise IndexError(i)
+        return tensors[i]
+    except (IndexError, TypeError, ValueError):
+        raise WireError(
+            f"segment count mismatch: body references tensor {idx!r}, "
+            f"frame carries {len(tensors)}")

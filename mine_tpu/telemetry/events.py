@@ -307,6 +307,11 @@ KIND_FIELDS: Dict[str, tuple] = {
     # accompanies the mark_dead membership edge on confirmed refusal.
     "serve.breaker": ("host", "state", "failures"),
     "serve.host_suspect": ("host", "state", "misses"),
+    # one point per serve_multihost_wire bench arm (bench.py, PR 20):
+    # wire codec (json|bin_f32|bin_int8) vs aggregate throughput and the
+    # measured payload bytes per rendered view — the binary-wire cost
+    # ledger the conductor and the soak's wire phase diff against
+    "serve.wire_point": ("codec", "views_per_sec", "bytes_per_view"),
 }
 
 

@@ -51,7 +51,12 @@ chaos never monkeypatches hostnet):
     bounded retry must absorb. Deterministic — two consecutive attempts
     of one request can never both land on the modulus.
   * ``net_truncate_times`` — the first k responses are truncated
-    mid-body (the client raises IncompleteRead; a retry re-reads).
+    mid-body. Format-aware damage (PR 20): a JSON response raises
+    IncompleteRead as before, while an ``mtpu-wire1`` binary frame is
+    CUT IN HALF and handed up, so the frame decoder's truncated-frame
+    tripwire must reject it (WireError) — either way the client's
+    bounded retry re-requests, proving corruption is retried not
+    crashed on.
   * ``net_partition`` — an asymmetric partition matrix as a
     comma-separated list of directed ``src>dst`` links to sever
     (``"h1>n2,h2>n1"``: the fronts named h1/h2 cannot reach the hosts

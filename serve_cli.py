@@ -218,7 +218,7 @@ def main():
     peer_clients = {}
     if serve_cfg.ring_enabled:
         from mine_tpu.serve import (Autoscaler, HostClient, HostRing,
-                                    NetPolicy, pressure_score)
+                                    NetPolicy, WirePolicy, pressure_score)
         # wire hardening (serve.net.*, default off): peer probes get the
         # split timeouts/retries/breakers, and /healthz surfaces every
         # peer's breaker state next to the ring view
@@ -242,6 +242,19 @@ def main():
                         net_policy.read_timeout_s, net_policy.retries,
                         net_policy.breaker_threshold,
                         net_policy.probe_interval_s)
+        # binary wire fabric (serve.wire.*, default off): peer clients
+        # negotiate mtpu-wire1 frames + the configured tensor codec;
+        # wire-off builds no policy and the transport is byte-identical
+        wire_policy = None
+        if serve_cfg.wire_format == "binary":
+            wire_policy = WirePolicy(
+                format=serve_cfg.wire_format,
+                codec=serve_cfg.wire_codec,
+                coalesce_ms=serve_cfg.wire_coalesce_ms,
+                coalesce_max=serve_cfg.wire_coalesce_max)
+            logger.info("binary wire: codec=%s coalesce_ms=%.1f "
+                        "coalesce_max=%d", wire_policy.codec,
+                        wire_policy.coalesce_ms, wire_policy.coalesce_max)
         ring = HostRing()
         ring.join("self", aot_loads=engine.bucket_loads,
                   aot_compiles=engine.bucket_compiles)
@@ -249,7 +262,8 @@ def main():
                                   for a in serve_cfg.ring_hosts.split(","))):
             ring.join(addr)
             client = HostClient(addr, timeout_s=2.0, policy=net_policy,
-                                net_src="self", net_name=addr)
+                                net_src="self", net_name=addr,
+                                wire_policy=wire_policy)
             if net_policy is not None:
                 peer_clients[addr] = client  # kept for breaker snapshots
             try:

@@ -75,6 +75,7 @@ QUICK = {
     "test_serve_fleet.py::test_shard_for_key_deterministic_range_partition",
     "test_serve_resilience.py::test_admission_tier_policy_matrix",
     "test_serve_net.py::test_breaker_state_machine_with_events",
+    "test_serve_wire.py::test_frame_multiple_tensors_and_order",
     "test_serve_ring.py::test_ring_covering_through_drains_and_deaths",
     "test_stream_session.py::test_keyframe_ids_share_prefix_and_owner_shard",
     "test_train.py::test_multistep_lr_schedule",

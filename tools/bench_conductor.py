@@ -101,6 +101,13 @@ LEVERS = [
     # Rides the same unknown-variant skip as serve_multihost on bench
     # builds predating the variant
     {"name": "serve_multihost_flaky"},
+    # binary-wire lever (serve.wire.*): the 2-host ring flood swept over
+    # codec json -> bin_f32 -> bin_int8 with mtpu-wire1 frames + the
+    # front's owner-coalescer on the binary arms; per-codec views/s +
+    # bytes/view + retry rate on stderr, keyed ips = bin_int8 views/s.
+    # Rides the same unknown-variant skip on bench builds predating
+    # serve.wire.*
+    {"name": "serve_multihost_wire"},
 ]
 
 PROMOTE_AT = 1.05

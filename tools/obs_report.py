@@ -15,7 +15,9 @@ serving-fleet cache placements/rebalances (serve.shard.* events), the
 multi-host ring timeline (serve.host_join / serve.host_drain /
 serve.autoscale / serve.ring_rebalance — join/drain history, the
 autoscaler's action trail, and the owner-hit vs remote-route split per
-host), the resilience history (serve.admission state transitions, shard death/revive
+host), the binary wire fabric (serve.wire_point bench arms + serve.wire.*
+counters/histograms out of the metrics snapshot),
+the resilience history (serve.admission state transitions, shard death/revive
 from serve.shard_dead / serve.shard_revive, shed/degraded/expired totals
 out of the metrics snapshot), SLO
 breaches (serve.slo_breach), the slowest request traces as per-trace
@@ -282,6 +284,37 @@ def report(events, log_lines):
             if still:
                 out.append("  still suspect at stream end: %s"
                            % ", ".join(still))
+
+    wire_points = [e for e in events if e.get("kind") == "serve.wire_point"]
+    snap_w = {}
+    for e in events:
+        if e.get("kind") == "metrics.snapshot" and e.get("metrics"):
+            snap_w = {k: v for k, v in e["metrics"].items()
+                      if k.startswith("serve.wire.")}
+    if wire_points or snap_w:
+        out.append("")
+        out.append("binary wire fabric (serve/wire.py, serve.wire.*):")
+        # one line per bench arm: codec throughput + bytes moved per view
+        for e in wire_points:
+            out.append("  arm %-10s %10.3f views/s %10.0f bytes/view"
+                       % (e.get("codec"),
+                          float(e.get("views_per_sec", 0.0)),
+                          float(e.get("bytes_per_view", 0.0))))
+        counters = ["%s=%s" % (k.rsplit(".", 1)[1], snap_w[k])
+                    for k in ("serve.wire.bytes_tx", "serve.wire.bytes_rx",
+                              "serve.wire.fallbacks", "serve.wire.rejects")
+                    if k in snap_w and not isinstance(snap_w[k], dict)]
+        if counters:
+            out.append("  counters: " + " ".join(counters))
+        for k in ("serve.wire.encode_ms", "serve.wire.decode_ms",
+                  "serve.wire.coalesce_size"):
+            v = snap_w.get(k)
+            if isinstance(v, dict):
+                out.append("  %-26s n=%-6s mean=%-9.2f p50=%-9.2f p99=%.2f"
+                           % (k.rsplit(".", 1)[1], v.get("count", 0),
+                              float(v.get("mean", 0.0)),
+                              float(v.get("p50", 0.0)),
+                              float(v.get("p99", 0.0))))
 
     admissions = [e for e in events if e.get("kind") == "serve.admission"]
     deaths = [e for e in events if e.get("kind") == "serve.shard_dead"]
@@ -561,6 +594,18 @@ def report_json(events, log_lines):
                                             "misses")}
                      for e in events
                      if e.get("kind") == "serve.host_suspect"],
+    }
+
+    # binary wire fabric: bench arm points plus the serve.wire.* slice of
+    # the final metrics snapshot (counters and encode/decode histograms)
+    out["wire"] = {
+        "points": [{k: e.get(k) for k in ("ts", "codec", "views_per_sec",
+                                          "bytes_per_view")}
+                   for e in events if e.get("kind") == "serve.wire_point"],
+        "metrics": {k: v
+                    for e in snaps[-1:]
+                    for k, v in (e.get("metrics") or {}).items()
+                    if k.startswith("serve.wire.")},
     }
 
     out["slo_breaches"] = [

@@ -28,6 +28,13 @@ in mine_tpu/testing/faults.py — never by monkeypatching serve code:
             retry + stale-reconnect paths must absorb every injected
             drop and truncation — zero critical failures, with retry
             counters proving the chaos actually bit.
+  wire      two arms over a wire-armed host pair under the flaky-link
+            plan: a JSON/base64 control, then mtpu-wire1 binary framing
+            with int8 wire quantization + the owner-coalescer. Zero
+            critical failures on both arms, truncated binary frames
+            rejected by the tripwires and RETRIED (never crashed on),
+            at least one coalesced same-owner batch, and strictly fewer
+            upload bytes than the JSON arm.
   partition an asymmetric partition matrix (net_partition="h1>n1,h2>n0")
             across three RingFronts over the same two hosts: suspicion
             stays FRONT-LOCAL (membership is single-writer), every view
@@ -62,6 +69,9 @@ exits NONZERO if any invariant breaks:
     kill, or ends with the session table non-empty;
   * the flaky-link phase leaks a single failure to the critical tier, or
     finishes with zero retries (the injection never bit);
+  * the wire phase fails a critical request on either arm, crashes on a
+    truncated binary frame instead of retrying it, coalesces nothing, or
+    ships MORE upload bytes on the binary arm than the JSON one;
   * the partition phase sees a front write ring membership, a key with
     no alive owner in any view, suspicion on the unpartitioned front,
     or an owner map that fails to re-converge after the heal;
@@ -469,6 +479,112 @@ def run_net_phases(args, check):
             srv.drain(reason="soak")  # drain closes the fleet too
 
 
+def run_wire_phase(args, check):
+    """Binary wire fabric phase (PR 20, serve.wire.*): two arms over the
+    same wire-armed host pair — a JSON/base64 control, then mtpu-wire1
+    binary framing with int8 wire quantization AND the owner-coalescer —
+    both under the PR-19 flaky-link plan (latency + truncated responses).
+
+    Invariants: zero critical failures on EITHER arm; the truncation must
+    actually bite (client retries > 0 — a truncated binary frame is
+    rejected by the mtpu-wire1 tripwires and retried, never crashed on);
+    the binary arm's coalescer must batch at least one same-owner group;
+    and the binary arm moves strictly fewer upload bytes (bytes_tx) than
+    the JSON arm for the same flood."""
+    import time
+
+    from mine_tpu.serve import (HostClient, HostRing, HostServer, NetPolicy,
+                                RingFront, ServeFleet)
+    from mine_tpu.serve.admission import TIER_CRITICAL
+    from mine_tpu.serve.wire import WirePolicy
+    from mine_tpu.telemetry import events as tevents
+    from mine_tpu.testing import faults
+    from mine_tpu.testing.faults import FaultPlan
+
+    fleets = {h: ServeFleet(cache_shards=1, max_requests=8, max_wait_ms=2.0,
+                            max_bucket=8, encode_fn=_encode_fn, ops_port=0)
+              for h in ("w0", "w1")}
+    wp = WirePolicy(format="binary", codec="int8", coalesce_ms=5.0,
+                    coalesce_max=8)
+    # the SERVER is always wire-armed; whether a link speaks binary is the
+    # client's negotiated choice, which is exactly what the two arms vary
+    servers = {h: HostServer(fleets[h], h, wire_policy=wp).start()
+               for h in fleets}
+    policy = NetPolicy(enabled=True, connect_timeout_s=5.0,
+                       read_timeout_s=args.timeout_s, retries=3,
+                       backoff_ms=2.0, breaker_threshold=50,
+                       breaker_reset_s=0.2)
+    w_keys = [_key(i % 2, 2, f"wire{i}") for i in range(args.host_flood)]
+    w_imgs = {k: _image(500 + i) for i, k in enumerate(w_keys)}
+    arms = {}
+    try:
+        for arm, arm_wp in (("json", None), ("bin_int8", wp)):
+            ring = HostRing()
+            handles = {}
+            for h in servers:
+                ring.join(h)
+                handles[h] = HostClient(f"127.0.0.1:{servers[h].port}",
+                                        policy=policy, net_src="front",
+                                        net_name=h, wire_policy=arm_wp)
+            front = RingFront(ring, handles, policy=policy, wire=arm_wp)
+            try:
+                # warm pass first: settles wire negotiation (whose one
+                # /healthz would otherwise silently eat the truncation
+                # budget) and pre-encodes every key, so the measured flood
+                # is pure render traffic
+                warm = _settle([(TIER_CRITICAL,
+                                 front.submit(k, POSE, tier=TIER_CRITICAL,
+                                              image=w_imgs[k]))
+                                for k in w_keys], args.timeout_s)
+                check(all(v == "ok" for _, v in warm),
+                      f"wire arm {arm} warm pass failed: {warm}")
+                tx0 = sum(c.bytes_tx for c in handles.values())
+                r0 = sum(c.retries for c in handles.values())
+                faults.set_plan(FaultPlan(net_latency_ms=1,
+                                          net_truncate_times=2))
+                t0 = time.perf_counter()
+                futs = [(TIER_CRITICAL,
+                         front.submit(k, POSE, tier=TIER_CRITICAL,
+                                      image=w_imgs[k])) for k in w_keys]
+                outcomes = _settle(futs, args.timeout_s)
+                dt = time.perf_counter() - t0
+                faults.set_plan(None)
+                bad = [v for _, v in outcomes if v != "ok"]
+                check(not bad,
+                      f"wire arm {arm} leaked critical failures: {bad}")
+                retries = sum(c.retries for c in handles.values()) - r0
+                check(retries > 0,
+                      f"wire arm {arm}: the truncation injection never bit "
+                      f"(no client retries — truncated frames must be "
+                      f"rejected and retried, not crashed on)")
+                moved = sum(c.bytes_tx for c in handles.values()) - tx0
+                coalesced = 0
+                if arm_wp is not None:
+                    wstats = front.stats().get("wire") or {}
+                    coalesced = int(wstats.get("coalesced", 0))
+                    check(coalesced > 0,
+                          "binary arm coalesced no same-owner groups "
+                          f"(stats={wstats})")
+                arms[arm] = moved
+                tevents.emit("serve.wire_point",
+                             codec=("int8" if arm_wp is not None else arm),
+                             views_per_sec=len(w_keys) / max(dt, 1e-9),
+                             bytes_per_view=moved / max(len(w_keys), 1))
+                print(f"phase=wire arm={arm} requests={len(futs)} "
+                      f"failures=0 retries={retries} bytes_tx={moved} "
+                      f"coalesced={coalesced}", flush=True)
+            finally:
+                faults.set_plan(None)
+                front.close()
+        check(arms["bin_int8"] < arms["json"],
+              f"binary wire moved {arms['bin_int8']} upload bytes vs "
+              f"JSON's {arms['json']} — the frame format saved nothing")
+    finally:
+        faults.set_plan(None)
+        for srv in servers.values():
+            srv.drain(reason="soak")  # drain closes the fleet too
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="serve-side chaos soak (overload + shard failover)")
@@ -672,6 +788,9 @@ def main():
         # ---- phases: flaky_link + partition (wire hardening) ----
         run_net_phases(args, check)
 
+        # ---- phase: wire (binary framing + int8 + coalescing) ----
+        run_wire_phase(args, check)
+
         # ---- phase: hosts (multi-host ring: kill + autoscale) ----
         if args.hosts > 0:
             run_hosts_phase(args, check, events_path)
@@ -690,7 +809,7 @@ def main():
     expected = ["serve.admission", "serve.shard_dead", "serve.shard_revive",
                 "serve.session_start", "serve.session_keyframe",
                 "serve.session_frame", "serve.session_end",
-                "serve.host_suspect", "obs.incident"]
+                "serve.host_suspect", "serve.wire_point", "obs.incident"]
     if args.hosts > 0:
         expected += ["serve.host_join", "serve.host_drain",
                      "serve.autoscale", "serve.ring_rebalance"]

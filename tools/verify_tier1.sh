@@ -111,6 +111,27 @@ if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     [ "$rc" -eq 0 ] && rc=1
 fi
 
+# the binary wire fabric's safety core is tier-1 (same wall-cap
+# rationale): wire OFF must stay byte-identical to the PR-19 JSON wire,
+# bin_f32 must be end-to-end bitwise vs JSON, hostile/truncated frames
+# must be rejected-and-retried (never crashed on), and the coalescer
+# must return every envelope to its own caller in order — a wire.py or
+# hostnet/ring regression on any of these fails tier-1 even when the
+# window axed tests/test_serve_wire.py
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_serve_wire.py::test_wire_off_payload_byte_identical_to_pr19" \
+        "tests/test_serve_wire.py::test_bin_f32_end_to_end_bitwise_vs_json" \
+        "tests/test_serve_wire.py::test_truncated_binary_frame_retried_not_crashed" \
+        "tests/test_serve_wire.py::test_hostile_binary_frame_rejected_with_400" \
+        "tests/test_serve_wire.py::test_coalesced_batch_ordering_under_mixed_tiers" \
+        -q -p no:cacheprovider -p no:randomly \
+        > /tmp/_t1_wire.txt 2>&1; then
+    tail -20 /tmp/_t1_wire.txt
+    echo "WIRE: binary wire-fabric safety gate failed (output in" \
+         "/tmp/_t1_wire.txt)"
+    [ "$rc" -eq 0 ] && rc=1
+fi
+
 # the incident-bundle capture/read contract is tier-1: postmortem's
 # selftest pushes a synthetic incident through the REAL FlightRecorder
 # dump path, renders it, and asserts a corrupted copy is rejected — so a
